@@ -1,0 +1,311 @@
+"""GPT-2 family — the flagship model for the TPU-native runtime.
+
+The reference frames GPT-2 through Megatron integration
+(`tests/model/Megatron_GPT2`); here the model is first-class and built for
+XLA: one transformer block scanned over the layer dimension
+(`nn.scan` → stacked [L, ...] params, single trace, pipeline-ready),
+optional `nn.remat` activation checkpointing, bf16 compute with fp32
+numerics where it matters (LayerNorm stats, softmax, loss), and
+einsum-phrased attention that XLA tiles directly onto the MXU.
+
+Tensor-parallel placement is expressed as PartitionSpec rules over the
+param tree (`tp_param_specs`) — Megatron column/row parallel linear layers
+(which the reference outsources to an external `mpu`,
+`deepspeed/__init__.py:79-80`) become sharding annotations: qkv/fc kernels
+column-sharded over `model`, proj kernels row-sharded, with XLA inserting
+the psum that Megatron codes by hand.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.runtime.mesh import MODEL_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16       # compute dtype
+    param_dtype: Any = jnp.float32  # storage dtype of trainable params
+    remat: bool = True              # activation-checkpoint each block
+    attention_impl: str = "auto"    # auto | pallas | xla
+    initializer_range: float = 0.02
+
+    @property
+    def head_dim(self):
+        assert self.n_embd % self.n_head == 0
+        return self.n_embd // self.n_head
+
+
+# Named model sizes (GPT-2 paper + GPT-3-style scale points used by the
+# reference's Megatron benchmarks).
+GPT2_SIZES = {
+    "gpt2-125m": dict(n_layer=12, n_embd=768, n_head=12),
+    "gpt2-350m": dict(n_layer=24, n_embd=1024, n_head=16),
+    "gpt2-760m": dict(n_layer=24, n_embd=1536, n_head=16),
+    "gpt2-1.5b": dict(n_layer=48, n_embd=1600, n_head=25),
+    "gpt2-2.7b": dict(n_layer=32, n_embd=2560, n_head=32),
+    "gpt2-6.7b": dict(n_layer=32, n_embd=4096, n_head=32),
+    "gpt2-13b": dict(n_layer=40, n_embd=5140, n_head=40),
+}
+
+
+def gpt2_config(name="gpt2-125m", **overrides) -> GPT2Config:
+    base = dict(GPT2_SIZES[name])
+    base.update(overrides)
+    return GPT2Config(**base)
+
+
+def _dense(features, config, name, init_scale=1.0):
+    return nn.Dense(
+        features,
+        dtype=config.dtype,
+        param_dtype=config.param_dtype,
+        kernel_init=nn.initializers.normal(config.initializer_range * init_scale),
+        bias_init=nn.initializers.zeros,
+        name=name)
+
+
+def causal_attention_xla(q, k, v, dropout_rng=None, dropout_rate=0.0,
+                         deterministic=True):
+    """Plain XLA attention: fp32 softmax, causal mask via lower-tri bias."""
+    head_dim = q.shape[-1]
+    scale = 1.0 / np.sqrt(head_dim)
+    # [B, H, T, T]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    t = q.shape[1]
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attention(config, q, k, v, dropout_rng, deterministic):
+    if config.attention_impl in ("pallas", "auto"):
+        try:
+            from deepspeed_tpu.ops.transformer.flash_attention import (
+                flash_attention_usable, flash_attention)
+            if flash_attention_usable(q, deterministic or config.dropout == 0.0):
+                return flash_attention(q, k, v, causal=True)
+        except ImportError:
+            pass
+        if config.attention_impl == "pallas":
+            raise RuntimeError("pallas attention requested but unusable "
+                               "for these shapes/settings")
+    return causal_attention_xla(q, k, v, dropout_rng, config.dropout,
+                                deterministic)
+
+
+class GPT2Block(nn.Module):
+    """Pre-LN transformer block (attention + MLP)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+        b, t, c = hidden.shape
+
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                           param_dtype=cfg.param_dtype, name="ln_1")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+                           param_dtype=cfg.param_dtype, name="ln_2")
+
+        # --- attention ---
+        x = ln1(hidden).astype(cfg.dtype)
+        qkv = _dense(3 * cfg.n_embd, cfg, "c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_head, cfg.head_dim)
+        drop_rng = None
+        if not deterministic and cfg.dropout > 0.0:
+            drop_rng = self.make_rng("dropout")
+        attn = _attention(cfg, q, k, v, drop_rng, deterministic)
+        attn = attn.reshape(b, t, cfg.n_embd)
+        # proj init scaled down by depth (GPT-2 residual-scaling trick)
+        attn = _dense(cfg.n_embd, cfg, "c_proj",
+                      init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(attn)
+        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        hidden = hidden + attn
+
+        # --- MLP ---
+        y = ln2(hidden).astype(cfg.dtype)
+        y = _dense(4 * cfg.n_embd, cfg, "c_fc")(y)
+        y = nn.gelu(y, approximate=True)
+        y = _dense(cfg.n_embd, cfg, "mlp_c_proj",
+                   init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return hidden + y
+
+
+class GPT2LMHeadModel(nn.Module):
+    """GPT-2 with tied-embedding LM head; returns logits."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, deterministic: bool = True,
+                 layer_keep_prob: Optional[jnp.ndarray] = None):
+        cfg = self.config
+        b, t = input_ids.shape
+
+        wte = self.param("wte",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.vocab_size, cfg.n_embd), cfg.param_dtype)
+        wpe = self.param("wpe",
+                         nn.initializers.normal(cfg.initializer_range),
+                         (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
+
+        hidden = wte[input_ids].astype(cfg.dtype) + \
+            wpe[:t][None, :, :].astype(cfg.dtype)
+        hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
+
+        # Scan one block over a stacked [n_layer, ...] param tree: single
+        # trace, O(1) compile in depth, and the layer dim is what pipeline
+        # parallelism later splits across stages.
+        ScannedBlocks = nn.scan(
+            _BlockScanCell,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(nn.broadcast, nn.broadcast),
+            length=cfg.n_layer,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"},
+        )
+        # Progressive layer drop: stochastic depth with keep-prob theta fed
+        # per step (ref `progressive_layer_drop.py:5`), applied as a
+        # bernoulli gate on each block's residual inside the scan.
+        keep = layer_keep_prob if layer_keep_prob is not None else None
+        hidden, _ = ScannedBlocks(cfg, name="h")(hidden, deterministic, keep)
+
+        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                              dtype=jnp.float32,
+                              param_dtype=cfg.param_dtype,
+                              name="ln_f")(hidden)
+        logits = jnp.einsum("btc,vc->btv", hidden.astype(cfg.dtype),
+                            wte.astype(cfg.dtype))
+        return logits
+
+
+class _BlockScanCell(nn.Module):
+    """Scan cell: threads hidden through one (optionally rematted,
+    optionally stochastic-depth-gated) block; returns (carry, None)."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, deterministic, keep_prob):
+        cfg = self.config
+        block_cls = GPT2Block
+        if cfg.remat:
+            block_cls = nn.remat(GPT2Block, prevent_cse=False,
+                                 static_argnums=(2,))
+        out = block_cls(cfg)(hidden, deterministic)
+        if keep_prob is not None:
+            if deterministic:
+                out = hidden + keep_prob * (out - hidden)
+            else:
+                gate = jax.random.bernoulli(self.make_rng("dropout"),
+                                            keep_prob)
+                out = jnp.where(gate, out, hidden)
+        return out, None
+
+
+def cross_entropy_loss(logits, labels, ignore_index=-100):
+    """Token-level CE in fp32; mean over non-ignored positions."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None],
+                               axis=-1).squeeze(-1)
+    nll = (logz - gold) * valid
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+class GPT2ForCausalLM:
+    """Engine-facing wrapper: `loss_fn(params, batch, rngs)` protocol.
+
+    batch = dict(input_ids=[B,T] int32, labels=[B,T] int32).  Labels are
+    next-token targets (already shifted) or raw ids (shift internally when
+    labels is None).
+    """
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+        self.module = GPT2LMHeadModel(config)
+
+    def init(self, rng, example_batch):
+        input_ids = example_batch["input_ids"]
+        variables = self.module.init({"params": rng, "dropout": rng},
+                                     input_ids, True)
+        return variables["params"]
+
+    def loss_fn(self, params, batch, rngs=None, deterministic=False,
+                layer_keep_prob=None):
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [input_ids[:, 1:],
+                 jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        kwargs = {}
+        if layer_keep_prob is not None:
+            kwargs["layer_keep_prob"] = layer_keep_prob
+        logits = self.module.apply({"params": params}, input_ids,
+                                   deterministic,
+                                   rngs=rngs or {}, **kwargs)
+        return cross_entropy_loss(logits, labels)
+
+    def apply(self, params, input_ids, deterministic=True):
+        return self.module.apply({"params": params}, input_ids, deterministic)
+
+    # -- tensor parallel placement ---------------------------------------
+    def tp_param_specs(self, params):
+        """PartitionSpec tree: Megatron-style column/row sharding over the
+        `model` mesh axis. Scanned blocks carry a leading layer dim."""
+        from flax.traverse_util import flatten_dict, unflatten_dict
+        flat = flatten_dict(params)
+        specs = {}
+        for path, leaf in flat.items():
+            name = "/".join(str(p) for p in path)
+            nd = np.ndim(leaf)
+            spec = [None] * nd
+            if name == "wte" or name == "wpe":
+                # vocab/position dim sharded over model axis
+                spec[0] = MODEL_AXIS
+            elif "c_attn" in name and name.endswith("kernel"):
+                spec[-1] = MODEL_AXIS          # column parallel
+            elif "c_attn" in name and name.endswith("bias"):
+                spec[-1] = MODEL_AXIS
+            elif "c_fc" in name and name.endswith("kernel"):
+                spec[-1] = MODEL_AXIS          # column parallel
+            elif "c_fc" in name and name.endswith("bias"):
+                spec[-1] = MODEL_AXIS
+            elif "c_proj" in name and name.endswith("kernel"):
+                spec[-2] = MODEL_AXIS          # row parallel
+            specs[path] = PartitionSpec(*spec)
+        return unflatten_dict(specs)
+
+
+def tiny_gpt2_config(**overrides):
+    """Small config for tests/CI (CPU-mesh friendly sizes)."""
+    base = dict(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                n_head=4, dropout=0.0, dtype=jnp.float32, remat=False)
+    base.update(overrides)
+    return GPT2Config(**base)

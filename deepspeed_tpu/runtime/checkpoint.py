@@ -1,0 +1,88 @@
+"""Checkpoint file I/O.
+
+Preserves the reference's on-disk layout (ref `engine.py:1255-1273`):
+
+    <save_dir>/<tag>/mp_rank_00_model_states.pt
+    <save_dir>/<tag>/zero_pp_rank_0_mp_rank_00optim_states.pt
+    <save_dir>/latest                      (pointer file)
+
+with one deliberate upgrade: state is always saved as *full* (unpartitioned)
+arrays, so every checkpoint is an "elastic checkpoint" — loading onto a
+different mesh/world size just re-applies the current sharding
+(`jax.device_put`), subsuming the reference's elastic-vs-rigid ZeRO-1
+formats (`stage1.py:825-1024`) and its topology-change restrictions.
+
+Serialization: numpy-pytree pickle (no torch). On multi-host, only process
+0 writes; arrays must be fully addressable or fully replicated (single-
+controller JAX guarantees this for state created through the engine).
+"""
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+MODEL_STATES_FMT = "mp_rank_{:02d}_model_states.pt"
+OPTIM_STATES_FMT = "zero_pp_rank_{}_mp_rank_{:02d}optim_states.pt"
+LATEST_FILE = "latest"
+
+
+def _to_numpy(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
+                                  tree)
+
+
+def _ckpt_dir(save_dir, tag):
+    return os.path.join(save_dir, str(tag))
+
+
+def model_states_path(save_dir, tag, mp_rank=0):
+    return os.path.join(_ckpt_dir(save_dir, tag),
+                        MODEL_STATES_FMT.format(mp_rank))
+
+
+def optim_states_path(save_dir, tag, dp_rank=0, mp_rank=0):
+    return os.path.join(_ckpt_dir(save_dir, tag),
+                        OPTIM_STATES_FMT.format(dp_rank, mp_rank))
+
+
+def save_checkpoint_files(save_dir, tag, model_sd, optim_sd,
+                          zero_enabled=False, mp_rank=0, dp_rank=0):
+    if jax.process_index() != 0:
+        return
+    os.makedirs(_ckpt_dir(save_dir, tag), exist_ok=True)
+    with open(model_states_path(save_dir, tag, mp_rank), "wb") as f:
+        pickle.dump(_to_numpy(model_sd), f, protocol=pickle.HIGHEST_PROTOCOL)
+    if optim_sd is not None:
+        with open(optim_states_path(save_dir, tag, dp_rank, mp_rank),
+                  "wb") as f:
+            pickle.dump(_to_numpy(optim_sd), f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_checkpoint_files(load_dir, tag, zero_enabled=True, mp_rank=0,
+                          dp_rank=0):
+    with open(model_states_path(load_dir, tag, mp_rank), "rb") as f:
+        model_sd = pickle.load(f)
+    optim_sd = None
+    opt_path = optim_states_path(load_dir, tag, dp_rank, mp_rank)
+    if os.path.exists(opt_path):
+        with open(opt_path, "rb") as f:
+            optim_sd = pickle.load(f)
+    return model_sd, optim_sd
+
+
+def write_latest_tag(save_dir, tag):
+    os.makedirs(save_dir, exist_ok=True)
+    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+        f.write(str(tag))
+
+
+def read_latest_tag(load_dir):
+    path = os.path.join(load_dir, LATEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r") as f:
+        return f.read().strip()

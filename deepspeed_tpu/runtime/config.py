@@ -1,0 +1,504 @@
+"""DeepSpeedConfig — JSON config parsing + batch-triple resolution.
+
+Parity with `deepspeed/runtime/config.py:515`:
+  * accepts a JSON file path or a dict
+  * batch triple: train_batch_size = micro_batch_per_gpu × grad_accum ×
+    data-parallel world size; any two determine the third
+    (ref `config.py:655-728`)
+  * subconfigs: fp16, zero_optimization, activation_checkpointing,
+    flops_profiler, tensorboard, pld, sparse_attention, pipeline
+  * elasticity: recomputes the batch triple from
+    DEEPSPEED_ELASTICITY_CONFIG env (ref `elasticity.py:207-237`)
+
+TPU-native additions: a `bf16` block (the natural TPU precision) and a
+`mesh` block naming device-mesh axis sizes.
+"""
+
+import os
+
+from deepspeed_tpu.runtime.constants import *  # noqa: F401,F403
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import (
+    get_scalar_param,
+    load_config_dict,
+)
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.activation_checkpointing.config import (
+    DeepSpeedActivationCheckpointingConfig, )
+from deepspeed_tpu.profiling.config import DeepSpeedFlopsProfilerConfig
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def get_fp16_enabled(param_dict):
+    if C.FP16 in param_dict:
+        return get_scalar_param(param_dict[C.FP16], C.FP16_ENABLED,
+                                C.FP16_ENABLED_DEFAULT)
+    return False
+
+
+def get_bfloat16_enabled(param_dict):
+    if C.BFLOAT16 in param_dict:
+        return get_scalar_param(param_dict[C.BFLOAT16], C.BFLOAT16_ENABLED,
+                                C.BFLOAT16_ENABLED_DEFAULT)
+    return False
+
+
+def get_loss_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE,
+                                C.FP16_LOSS_SCALE_DEFAULT)
+    return C.FP16_LOSS_SCALE_DEFAULT
+
+
+def get_initial_dynamic_scale(param_dict):
+    if get_fp16_enabled(param_dict):
+        initial_scale_power = get_scalar_param(param_dict[C.FP16],
+                                               C.FP16_INITIAL_SCALE_POWER,
+                                               C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+    else:
+        initial_scale_power = C.FP16_INITIAL_SCALE_POWER_DEFAULT
+    return 2**initial_scale_power
+
+
+def get_dynamic_loss_scale_args(param_dict):
+    loss_scale_args = None
+    if get_fp16_enabled(param_dict):
+        fp16_dict = param_dict[C.FP16]
+        dynamic_props = [
+            C.FP16_INITIAL_SCALE_POWER, C.FP16_LOSS_SCALE_WINDOW,
+            C.FP16_MIN_LOSS_SCALE, C.FP16_HYSTERESIS
+        ]
+        if any(p in fp16_dict for p in dynamic_props):
+            init_scale = get_scalar_param(fp16_dict, C.FP16_INITIAL_SCALE_POWER,
+                                          C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+            scale_window = get_scalar_param(fp16_dict, C.FP16_LOSS_SCALE_WINDOW,
+                                            C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+            delayed_shift = get_scalar_param(fp16_dict, C.FP16_HYSTERESIS,
+                                             C.FP16_HYSTERESIS_DEFAULT)
+            min_loss_scale = get_scalar_param(fp16_dict, C.FP16_MIN_LOSS_SCALE,
+                                              C.FP16_MIN_LOSS_SCALE_DEFAULT)
+            loss_scale_args = {
+                "init_scale": 2**init_scale,
+                "scale_window": scale_window,
+                "delayed_shift": delayed_shift,
+                "min_scale": min_loss_scale,
+            }
+    return loss_scale_args
+
+
+def get_gradient_accumulation_steps(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_ACCUMULATION_STEPS,
+                            C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+
+
+def get_sparse_gradients_enabled(param_dict):
+    return get_scalar_param(param_dict, C.SPARSE_GRADIENTS,
+                            C.SPARSE_GRADIENTS_DEFAULT)
+
+
+def get_gradient_clipping(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_CLIPPING,
+                            C.GRADIENT_CLIPPING_DEFAULT)
+
+
+def get_sparse_attention(param_dict):
+    if C.SPARSE_ATTENTION in param_dict:
+        sparsity = param_dict[C.SPARSE_ATTENTION]
+        mode = get_scalar_param(sparsity, C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
+        sparsity = dict(sparsity)
+        sparsity[C.SPARSE_MODE] = mode
+        return sparsity
+    return None
+
+
+def get_optimizer_name(param_dict):
+    if C.OPTIMIZER in param_dict and C.TYPE in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.TYPE]
+    return C.OPTIMIZER_TYPE_DEFAULT
+
+
+def get_optimizer_params(param_dict):
+    if get_optimizer_name(param_dict) is not None and \
+            C.OPTIMIZER_PARAMS in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.OPTIMIZER_PARAMS]
+    return None
+
+
+def get_optimizer_gradient_clipping(param_dict):
+    optimizer_params = get_optimizer_params(param_dict)
+    if optimizer_params is not None and C.MAX_GRAD_NORM in optimizer_params:
+        return optimizer_params[C.MAX_GRAD_NORM]
+    return None
+
+
+def get_optimizer_legacy_fusion(param_dict):
+    if C.OPTIMIZER in param_dict and C.LEGACY_FUSION in param_dict[C.OPTIMIZER]:
+        return param_dict[C.OPTIMIZER][C.LEGACY_FUSION]
+    return C.LEGACY_FUSION_DEFAULT
+
+
+def get_zero_allow_untested_optimizer(param_dict):
+    return get_scalar_param(param_dict, C.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+                            C.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+
+def get_scheduler_name(param_dict):
+    if C.SCHEDULER in param_dict and C.TYPE in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.TYPE]
+    return C.SCHEDULER_TYPE_DEFAULT
+
+
+def get_scheduler_params(param_dict):
+    if get_scheduler_name(param_dict) is not None and \
+            C.SCHEDULER_PARAMS in param_dict[C.SCHEDULER]:
+        return param_dict[C.SCHEDULER][C.SCHEDULER_PARAMS]
+    return None
+
+
+def get_train_batch_size(param_dict):
+    return get_scalar_param(param_dict, C.TRAIN_BATCH_SIZE,
+                            C.TRAIN_BATCH_SIZE_DEFAULT)
+
+
+def get_train_micro_batch_size_per_gpu(param_dict):
+    return get_scalar_param(param_dict, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+
+
+def get_steps_per_print(param_dict):
+    return get_scalar_param(param_dict, C.STEPS_PER_PRINT,
+                            C.STEPS_PER_PRINT_DEFAULT)
+
+
+def get_wall_clock_breakdown(param_dict):
+    return get_scalar_param(param_dict, C.WALL_CLOCK_BREAKDOWN,
+                            C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+
+
+def get_memory_breakdown(param_dict):
+    return get_scalar_param(param_dict, C.MEMORY_BREAKDOWN,
+                            C.MEMORY_BREAKDOWN_DEFAULT)
+
+
+def get_dump_state(param_dict):
+    return get_scalar_param(param_dict, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+
+
+def get_prescale_gradients(param_dict):
+    return get_scalar_param(param_dict, C.PRESCALE_GRADIENTS,
+                            C.PRESCALE_GRADIENTS_DEFAULT)
+
+
+def get_gradient_predivide_factor(param_dict):
+    return get_scalar_param(param_dict, C.GRADIENT_PREDIVIDE_FACTOR,
+                            C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+
+def get_allreduce_always_fp32(param_dict):
+    return get_scalar_param(param_dict, C.FP32_ALLREDUCE,
+                            C.FP32_ALLREDUCE_DEFAULT)
+
+
+def get_disable_allgather(param_dict):
+    return get_scalar_param(param_dict, C.DISABLE_ALLGATHER,
+                            C.DISABLE_ALLGATHER_DEFAULT)
+
+
+def get_tensorboard_enabled(param_dict):
+    if C.TENSORBOARD in param_dict:
+        return get_scalar_param(param_dict[C.TENSORBOARD], C.TENSORBOARD_ENABLED,
+                                C.TENSORBOARD_ENABLED_DEFAULT)
+    return False
+
+
+def get_tensorboard_output_path(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[C.TENSORBOARD],
+                                C.TENSORBOARD_OUTPUT_PATH,
+                                C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+    return C.TENSORBOARD_OUTPUT_PATH_DEFAULT
+
+
+def get_tensorboard_job_name(param_dict):
+    if get_tensorboard_enabled(param_dict):
+        return get_scalar_param(param_dict[C.TENSORBOARD],
+                                C.TENSORBOARD_JOB_NAME,
+                                C.TENSORBOARD_JOB_NAME_DEFAULT)
+    return C.TENSORBOARD_JOB_NAME_DEFAULT
+
+
+def get_checkpoint_tag_validation(param_dict):
+    checkpoint_dict = param_dict.get(C.CHECKPOINT, {})
+    mode = get_scalar_param(checkpoint_dict, C.CHECKPOINT_TAG_VALIDATION,
+                            C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+    mode = mode.capitalize()
+    if mode not in C.CHECKPOINT_TAG_VALIDATION_MODES:
+        raise DeepSpeedConfigError(
+            f"checkpoint.tag_validation mode {mode} not one of "
+            f"{C.CHECKPOINT_TAG_VALIDATION_MODES}")
+    return mode
+
+
+def get_pld_enabled(param_dict):
+    if C.PROGRESSIVE_LAYER_DROP in param_dict:
+        return get_scalar_param(param_dict[C.PROGRESSIVE_LAYER_DROP],
+                                C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+    return False
+
+
+def get_pld_params(param_dict):
+    if C.PROGRESSIVE_LAYER_DROP in param_dict:
+        pld_params = dict(param_dict[C.PROGRESSIVE_LAYER_DROP])
+        pld_params.pop(C.PLD_ENABLED, None)
+        return pld_params
+    return False
+
+
+def get_pipeline_config(param_dict):
+    return get_scalar_param(param_dict, C.PIPELINE, dict(C.PIPELINE_DEFAULT))
+
+
+def get_mesh_config(param_dict):
+    return get_scalar_param(param_dict, C.MESH, None)
+
+
+class DeepSpeedConfigWriter:
+    """Minimal key-value holder used by tests/tools to compose configs."""
+
+    def __init__(self, data=None):
+        self.data = data if data is not None else {}
+
+    def add_config(self, key, value):
+        self.data[key] = value
+
+    def load_config(self, filename):
+        self.data = load_config_dict(filename)
+
+    def write_config(self, filename):
+        import json
+        with open(filename, "w") as outfile:
+            json.dump(self.data, outfile)
+
+
+class DeepSpeedConfig:
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None,
+                 world_size=None):
+        if param_dict is None:
+            self._param_dict = load_config_dict(json_file_or_dict)
+        else:
+            self._param_dict = param_dict
+
+        # Data-parallel world size. On TPU this is the size of the `data`
+        # mesh axis; default = all addressable devices (single-axis DP).
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = self._infer_world_size()
+
+        # Elasticity: env-provided config overrides the batch triple.
+        self.elasticity_enabled = False
+        ec = self._param_dict.get("elasticity", None)
+        if ec is not None and ec.get("enabled", False):
+            self._apply_elasticity(ec)
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    @staticmethod
+    def _infer_world_size():
+        try:
+            import jax
+            return jax.device_count()
+        except Exception:
+            return 1
+
+    def _apply_elasticity(self, ec):
+        from deepspeed_tpu import elasticity as el
+        from deepspeed_tpu.version import __version__
+        self.elasticity_enabled = True
+
+        # Explicit batch settings conflict with elasticity unless the user
+        # opts out (ref elasticity behavior: ignore_non_elastic_batch_info).
+        ignore = ec.get(el.IGNORE_NON_ELASTIC_BATCH_INFO,
+                        el.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+        batch_keys = [C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                      C.GRADIENT_ACCUMULATION_STEPS]
+        if not ignore:
+            present = [k for k in batch_keys if k in self._param_dict]
+            if present:
+                raise el.ElasticityConfigError(
+                    f"Elasticity is enabled but batch parameters {present} "
+                    f"are also set; remove them or set "
+                    f"'{el.IGNORE_NON_ELASTIC_BATCH_INFO}': true")
+
+        final_batch_size, valid_gpus, micro_batch_size = \
+            el.compute_elastic_config(
+                ds_config=self._param_dict,
+                target_deepspeed_version=__version__,
+                world_size=self.world_size)
+        if os.environ.get(el.DEEPSPEED_ELASTICITY_CONFIG) is not None:
+            el.ensure_immutable_elastic_config(runtime_elastic_config_dict=ec)
+        self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict.pop(C.GRADIENT_ACCUMULATION_STEPS, None)
+
+    def _initialize_params(self, param_dict):
+        self.train_batch_size = get_train_batch_size(param_dict)
+        self.train_micro_batch_size_per_gpu = \
+            get_train_micro_batch_size_per_gpu(param_dict)
+        self.gradient_accumulation_steps = \
+            get_gradient_accumulation_steps(param_dict)
+        self.steps_per_print = get_steps_per_print(param_dict)
+        self.dump_state = get_dump_state(param_dict)
+
+        self.disable_allgather = get_disable_allgather(param_dict)
+        self.allreduce_always_fp32 = get_allreduce_always_fp32(param_dict)
+        self.prescale_gradients = get_prescale_gradients(param_dict)
+        self.gradient_predivide_factor = get_gradient_predivide_factor(param_dict)
+        self.sparse_gradients_enabled = get_sparse_gradients_enabled(param_dict)
+
+        self.zero_config = DeepSpeedZeroConfig(param_dict)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(param_dict)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(param_dict)
+
+        self.fp16_enabled = get_fp16_enabled(param_dict)
+        self.bfloat16_enabled = get_bfloat16_enabled(param_dict)
+        assert not (self.fp16_enabled and self.bfloat16_enabled), \
+            "fp16 and bf16 modes are mutually exclusive"
+        self.loss_scale = get_loss_scale(param_dict)
+        self.initial_dynamic_scale = get_initial_dynamic_scale(param_dict)
+        self.dynamic_loss_scale_args = get_dynamic_loss_scale_args(param_dict)
+
+        self.gradient_clipping = get_gradient_clipping(param_dict)
+
+        self.optimizer_name = get_optimizer_name(param_dict)
+        if self.optimizer_name is not None and \
+                self.optimizer_name.lower() in C.DEEPSPEED_OPTIMIZERS:
+            self.optimizer_name = self.optimizer_name.lower()
+        self.optimizer_params = get_optimizer_params(param_dict)
+        self.optimizer_legacy_fusion = get_optimizer_legacy_fusion(param_dict)
+        self.zero_allow_untested_optimizer = \
+            get_zero_allow_untested_optimizer(param_dict)
+
+        self.scheduler_name = get_scheduler_name(param_dict)
+        self.scheduler_params = get_scheduler_params(param_dict)
+
+        self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
+        self.memory_breakdown = get_memory_breakdown(param_dict)
+        self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
+        self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
+        self.tensorboard_job_name = get_tensorboard_job_name(param_dict)
+
+        self.sparse_attention = get_sparse_attention(param_dict)
+        self.pipeline = get_pipeline_config(param_dict)
+        self.mesh = get_mesh_config(param_dict)
+
+        self.pld_enabled = get_pld_enabled(param_dict)
+        self.pld_params = get_pld_params(param_dict)
+
+        checkpoint_tag_validation_mode = get_checkpoint_tag_validation(param_dict)
+        self.checkpoint_tag_validation_enabled = \
+            checkpoint_tag_validation_mode != "Ignore"
+        self.checkpoint_tag_validation_fail = \
+            checkpoint_tag_validation_mode == "Fail"
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        assert train_batch > 0, \
+            f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, \
+            f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, \
+            f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal"
+            f" to micro_batch_per_gpu * gradient_acc_step * world_size"
+            f" {train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _set_batch_related_parameters(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+
+        # All three provided — assertion below checks consistency.
+        if train_batch is not None and micro_batch is not None and \
+                grad_acc is not None:
+            return
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= self.world_size
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // self.world_size
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            train_batch = micro_batch * grad_acc * self.world_size
+            self.train_batch_size = train_batch
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // self.world_size
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * self.world_size
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _do_sanity_check(self):
+        self._do_error_check()
+        self._do_warning_check()
+
+    def _do_error_check(self):
+        assert self.train_micro_batch_size_per_gpu, \
+            f"DeepSpeedConfig: {C.TRAIN_MICRO_BATCH_SIZE_PER_GPU} is not defined"
+        assert self.gradient_accumulation_steps, \
+            f"DeepSpeedConfig: {C.GRADIENT_ACCUMULATION_STEPS} is not defined"
+        if self.zero_enabled:
+            from deepspeed_tpu.runtime.zero.config import MAX_STAGE_ZERO_OPTIMIZATION
+            assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION
+
+    def _do_warning_check(self):
+        fp16_enabled = self.fp16_enabled or self.zero_enabled
+        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        if vocabulary_size and vocabulary_size % 8 != 0:
+            logger.warning(
+                "DeepSpeedConfig: vocabulary size should be aligned to 8 for "
+                "good MXU utilization")
+        if self.optimizer_params is not None and \
+                C.MAX_GRAD_NORM in self.optimizer_params and \
+                self.optimizer_params[C.MAX_GRAD_NORM] > 0:
+            if fp16_enabled:
+                logger.warning(
+                    "DeepSpeedConfig: In FP16 mode, DeepSpeed will pass "
+                    f"{C.MAX_GRAD_NORM} to FP16 wrapper")
+            else:
+                logger.warning(
+                    f"DeepSpeedConfig: In FP32 mode, DeepSpeed does not permit "
+                    f"{C.MAX_GRAD_NORM} in the optimizer config")
+
+    def print(self, name):
+        logger.info("{}:".format(name))
+        for arg in sorted(vars(self)):
+            if arg != "_param_dict":
+                dots = "." * (29 - len(arg))
+                logger.info("  {} {} {}".format(arg, dots, getattr(self, arg)))

@@ -1,0 +1,69 @@
+"""Device-mesh management.
+
+TPU-native replacement for the reference's process-group zoo
+(`deepspeed/runtime/pipe/topology.py:252-455` builds NCCL groups per axis):
+one `jax.sharding.Mesh` with named axes ('pipe', 'data', 'model') covers
+every collective the framework issues — XLA lowers them onto ICI/DCN.
+
+Config: {"mesh": {"pipe": 1, "data": -1, "model": 1}}; -1 infers the axis
+size from the device count. Defaults to pure data parallelism.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PIPE_AXIS = "pipe"
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+
+
+def build_mesh(mesh_config: Optional[dict] = None, devices=None) -> Mesh:
+    """Build a 3-axis mesh.  Axis order (pipe, data, model) keeps the
+    model axis innermost/fastest-varying — tensor-parallel collectives are
+    the most latency-sensitive, so they get the shortest ICI hops."""
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    cfg = dict(mesh_config or {})
+    pipe = int(cfg.get(PIPE_AXIS, 1))
+    data = int(cfg.get(DATA_AXIS, -1))
+    model = int(cfg.get(MODEL_AXIS, 1))
+
+    known = [s for s in (pipe, data, model) if s != -1]
+    n_known = math.prod(known) if known else 1
+    n_unknown = sum(1 for s in (pipe, data, model) if s == -1)
+    assert n_unknown <= 1, "at most one mesh axis may be -1 (inferred)"
+    if n_unknown == 1:
+        assert n % n_known == 0, \
+            f"device count {n} not divisible by fixed axis product {n_known}"
+        inferred = n // n_known
+        pipe = inferred if pipe == -1 else pipe
+        data = inferred if data == -1 else data
+        model = inferred if model == -1 else model
+    assert pipe * data * model == n, \
+        f"mesh {pipe}x{data}x{model} != device count {n}"
+
+    dev_array = np.asarray(devices).reshape(pipe, data, model)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """Batch-dim sharding for input arrays: shard dim 0 over ('pipe','data')
+    so the global batch divides across all non-model devices."""
+    spec = [None] * ndim
+    spec[0] = (PIPE_AXIS, DATA_AXIS) if mesh.shape[PIPE_AXIS] > 1 else DATA_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def batch_sharding_for_tree(mesh: Mesh, tree):
+    return jax.tree_util.tree_map(
+        lambda x: data_sharding(mesh, np.ndim(x)), tree)

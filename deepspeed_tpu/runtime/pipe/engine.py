@@ -1,0 +1,15 @@
+"""PipelineEngine — scheduled pipeline-parallel training.
+
+Counterpart of `deepspeed/runtime/pipe/engine.py:45`. Implemented in the
+pipeline milestone; this placeholder keeps `deepspeed_tpu.initialize`
+honest until then.
+"""
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "PipelineEngine is under construction in this build; "
+            "use DeepSpeedEngine (non-pipeline) configs meanwhile")

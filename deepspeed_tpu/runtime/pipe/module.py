@@ -1,0 +1,206 @@
+"""Pipeline model description: LayerSpec / TiedLayerSpec / PipelineModule.
+
+Parity with `deepspeed/runtime/pipe/module.py:23,71,85`, TPU-native: a
+PipelineModule is a *description* (list of layer specs + a partitioning)
+that the PipelineEngine lowers to an SPMD program — stage-stacked
+parameters sharded over the `pipe` mesh axis, microbatch activations
+rotated with `ppermute`. Deferred construction (LayerSpec) is kept: on a
+pod only the stage owner ever materializes a layer's params (memory
+parity with ref `module.py:197-249`), which under GSPMD means: params are
+created host-side per layer and device_put with a pipe-axis sharding.
+
+Partition methods (ref `module.py:348-403`): 'parameters' (balance param
+counts), 'uniform' (balance layer counts), 'type:regex' (balance layers
+whose class name matches the regex).
+"""
+
+import re
+from typing import Any, Callable, List, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.runtime.utils import (partition_balanced,
+                                         partition_uniform)
+from deepspeed_tpu.utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer construction (ref `module.py:23-68`).
+
+    typename: a flax Module class or any callable factory; building is
+    delayed until `build()` so a 100-layer model doesn't materialize
+    anything until stages are assigned.
+    """
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not callable(typename):
+            raise RuntimeError("LayerSpec typename must be callable "
+                               "(flax Module class or factory)")
+
+    def __repr__(self):
+        from deepspeed_tpu.runtime.utils import call_to_str
+        return call_to_str(getattr(self.typename, "__name__",
+                                   str(self.typename)),
+                           *self.module_args, **self.module_kwargs)
+
+    def build(self, log=False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Weight tying across stages (ref `module.py:71-82`): layers sharing
+    `key` share one parameter tree; the engine keeps tied params replicated
+    over the pipe axis and sums their grads (the SPMD form of the tied-grad
+    allreduce at ref `module.py:405-409`)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Layer-list model for pipeline execution (ref `module.py:85`)."""
+
+    def __init__(self,
+                 layers,
+                 num_stages=None,
+                 topology=None,
+                 loss_fn=None,
+                 seed_layers=False,
+                 seed_fn=None,
+                 base_seed=1234,
+                 partition_method="parameters",
+                 activation_checkpoint_interval=0,
+                 activation_checkpoint_func=None):
+        self._layer_specs = list(layers)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.topology = topology
+
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self.num_stages = num_stages or 1
+
+        self._build_layers()
+        self.parts = self._partition_layers()
+
+    # -- construction ----------------------------------------------------
+    def _build_layers(self):
+        self.forward_funcs: List[Any] = []
+        self.tied_modules = {}
+        self.tied_weight_attrs = {}
+        self.layers = []
+        for idx, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in self.tied_modules:
+                    self.tied_modules[spec.key] = spec.build()
+                    self.tied_weight_attrs[spec.key] = spec.tied_weight_attr
+                layer = self.tied_modules[spec.key]
+                fn = spec.forward_fn or layer
+                self.layers.append(layer)
+                self.forward_funcs.append(fn)
+            elif isinstance(spec, LayerSpec):
+                layer = spec.build()
+                self.layers.append(layer)
+                self.forward_funcs.append(layer)
+            elif callable(spec):
+                self.layers.append(spec)
+                self.forward_funcs.append(spec)
+            else:
+                raise TypeError(f"layer {idx} is neither LayerSpec nor "
+                                f"callable: {type(spec)}")
+
+    def __len__(self):
+        return len(self._layer_specs)
+
+    # -- partitioning (ref module.py:348-403) ---------------------------
+    def _count_layer_params(self):
+        """Estimated parameter count per layer for balancing, without
+        materializing device arrays."""
+        counts = []
+        for layer in self.layers:
+            n = 0
+            if hasattr(layer, "param_count"):
+                n = int(layer.param_count())
+            elif hasattr(layer, "num_params"):
+                n = int(layer.num_params)
+            counts.append(max(n, 1))
+        return counts
+
+    def _partition_layers(self):
+        method = (self.partition_method or "parameters").lower()
+        num_layers = len(self._layer_specs)
+        if method == "uniform":
+            parts = partition_uniform(num_layers, self.num_stages)
+        elif method in ("parameters", "params"):
+            weights = self._count_layer_params()
+            parts = partition_balanced(weights, self.num_stages)
+        elif method.startswith("type:"):
+            layertype = method.split(":", 1)[1]
+            binary_weights = [0] * num_layers
+            for idx, layer in enumerate(self.layers):
+                name = type(layer).__name__ if not isinstance(
+                    layer, type) else layer.__name__
+                if regex_matches(layertype, name):
+                    binary_weights[idx] = 1
+            parts = partition_balanced(binary_weights, self.num_stages)
+        elif method == "profile":
+            raise NotImplementedError(
+                "profile-based partitioning not implemented")
+        else:
+            raise NotImplementedError(
+                f"Partitioning method {method} not implemented")
+        for stage in range(self.num_stages):
+            start, stop = parts[stage], parts[stage + 1]
+            logger.info(f"pipeline stage={stage} layers={stop - start} "
+                        f"[{start}..{stop})")
+        return parts
+
+    def stage_layer_range(self, stage_id):
+        return self.parts[stage_id], self.parts[stage_id + 1]
+
+    def stage_layers(self, stage_id):
+        start, stop = self.stage_layer_range(stage_id)
+        return self.forward_funcs[start:stop]
+
+    def mpu(self):
+        return self.topology
+
+    # -- functional init/apply (used by the pipeline engine) -------------
+    def init_params(self, rng, example_input):
+        """Initialize one param tree per layer: list indexed by layer."""
+        params = []
+        x = example_input
+        for idx, layer in enumerate(self.layers):
+            rng, sub = jax.random.split(rng)
+            if hasattr(layer, "init"):
+                variables = layer.init({"params": sub, "dropout": sub}, x)
+                p = variables.get("params", variables)
+                params.append(p)
+                x = layer.apply({"params": p}, x)
+            else:
+                params.append({})
+                x = layer(x)
+        return params
+
+    def apply_layer(self, idx, params, x, rngs=None):
+        layer = self.layers[idx]
+        if hasattr(layer, "apply"):
+            return layer.apply({"params": params}, x, rngs=rngs)
+        return layer(x)
+
+
+def regex_matches(pattern, name):
+    return re.search(pattern, name) is not None

@@ -1,0 +1,33 @@
+"""Progressive layer drop (parity with
+`deepspeed/runtime/progressive_layer_drop.py:5`).
+
+Keep-probability schedule theta(t) = (1 - theta) * exp(-gamma * t) + theta.
+The engine feeds the current theta into the model each step; GPT-2 applies
+it as a scan-carried stochastic-depth gate (see `models/gpt2.py`).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+        log_dist("Enabled progressive layer dropping (theta = {})".format(
+            self.theta), ranks=[0])
+
+    def get_state(self):
+        kwargs = {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+        return kwargs
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, gamma, p):
+            return (1. - p) * np.exp(-gamma * x) + p
+
+        self.current_theta = _prob(global_step, self.gamma, self.theta)

@@ -1,0 +1,162 @@
+"""Runtime utilities: partitioning math, norms, memory reporting.
+
+Parity with `deepspeed/runtime/utils.py` — the pieces that survive the
+move to SPMD: `partition_uniform`/`partition_balanced` (used by pipeline
+stage assignment, ref `utils.py:311,377`), global-norm helpers (the
+cross-rank overflow vote, ref `utils.py:63`, is free under SPMD: every
+device computes the same reduction), and device memory reporting.
+`PartitionedTensor` (ref `utils.py:395-505`) has no analogue — a sharded
+jax.Array with a NamedSharding *is* a partitioned tensor with meta.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def ensure_directory_exists(filename):
+    import os
+    dirname = os.path.dirname(filename)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+
+
+class CheckOverflow:
+    """Overflow check over a pytree of grads. Under SPMD this is a pure
+    function of the (globally consistent) grads — no collective vote."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False):
+        self.mpu = mpu
+        self.params = param_groups
+
+    @staticmethod
+    def has_overflow(grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        if not leaves:
+            return jnp.asarray(False)
+        finite = jnp.stack(
+            [jnp.all(jnp.isfinite(g)) for g in leaves])
+        return ~jnp.all(finite)
+
+    check = has_overflow
+
+
+def get_grad_norm(tree, norm_type=2):
+    """Global gradient norm in fp32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    if norm_type == float("inf") or norm_type == "inf":
+        return jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    sq = [jnp.vdot(l.astype(jnp.float32), l.astype(jnp.float32))
+          for l in leaves]
+    return jnp.sqrt(jnp.sum(jnp.stack(sq)))
+
+
+get_weight_norm = get_grad_norm
+
+
+def clip_grad_norm_(tree, max_norm, norm_type=2):
+    """Return (clipped_tree, norm). Functional — no in-place mutation."""
+    norm = get_grad_norm(tree, norm_type)
+    factor = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: g * factor, tree), norm
+
+
+def partition_uniform(num_items, num_parts):
+    """Evenly spread items over parts; returns part boundaries (len
+    num_parts+1), ref `utils.py:311`."""
+    parts = [0] * (num_parts + 1)
+    chunksize = num_items // num_parts
+    for p in range(num_parts):
+        parts[p] = min(chunksize * p, num_items)
+    parts[num_parts] = num_items
+    return parts
+
+
+def prefix_sum_inc(weights):
+    """Inclusive prefix sum."""
+    out = list(weights)
+    for i in range(1, len(out)):
+        out[i] += out[i - 1]
+    return out
+
+
+def _lprobe(weights, num_parts, bottleneck):
+    """Greedy probe: can we split `weights` into `num_parts` chunks each
+    summing <= bottleneck? Returns (parts, success)."""
+    parts = [0]
+    total = 0
+    for i, w in enumerate(weights):
+        if total + w > bottleneck and total > 0:
+            parts.append(i)
+            total = 0
+            if len(parts) > num_parts:
+                return parts, False
+        total += w
+    while len(parts) < num_parts:
+        parts.append(len(weights))
+    parts.append(len(weights))
+    return parts[:num_parts + 1], len(parts) <= num_parts + 1
+
+
+def partition_balanced(weights, num_parts, eps=1e-3):
+    """Binary-search the minimal bottleneck so each contiguous part's
+    weight sum <= bottleneck (ref `utils.py:377`). Returns boundaries of
+    length num_parts+1."""
+    weights = list(weights)
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    lo = max(weights)
+    hi = sum(weights)
+    while hi - lo > eps * max(1.0, hi):
+        mid = (lo + hi) / 2
+        _, ok = _lprobe(weights, num_parts, mid)
+        if ok:
+            hi = mid
+        else:
+            lo = mid
+    parts, ok = _lprobe(weights, num_parts, hi)
+    assert ok
+    return parts
+
+
+def see_memory_usage(message, force=False):
+    if not force:
+        return
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        ga = stats.get("bytes_in_use", 0) / (1024**3)
+        peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+        limit = stats.get("bytes_limit", 0) / (1024**3)
+        logger.info(f"{message} | DeviceMem in-use {ga:.2f} GB "
+                    f"peak {peak:.2f} GB limit {limit:.2f} GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable")
+
+
+def memory_status(msg, print_rank=-1, reset_max=False):
+    see_memory_usage(msg, force=True)
+
+
+def global_norm_squared(tree):
+    return get_grad_norm(tree) ** 2
+
+
+def call_to_str(base, *args, **kwargs):
+    """Construct a string representation of a call (ref `utils.py`)."""
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(arg) for arg in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{key}={repr(arg)}"
+                          for key, arg in kwargs.items())
+    name += ")"
+    return name

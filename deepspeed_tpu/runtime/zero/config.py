@@ -1,0 +1,131 @@
+"""ZeRO config block.
+
+Parity with `deepspeed/runtime/zero/config.py:12` + `zero/constants.py`.
+On TPU the stages are realized as GSPMD sharding policies over the `data`
+mesh axis (see `deepspeed_tpu/runtime/zero/partition.py`):
+
+  stage 0: replicated everything, grads all-reduced (psum)
+  stage 1: optimizer state (fp32 master + moments) sharded over `data`
+  stage 2: + gradient accumulation buffers sharded (reduce-scatter)
+  stage 3: + parameters sharded (FSDP-style all-gather on use)
+
+Bucket-size knobs are accepted for config compatibility; XLA's collective
+scheduler replaces manual bucketing, so they act as hints only.
+"""
+
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+
+ZERO_OPTIMIZATION = "zero_optimization"
+
+ZERO_OPTIMIZATION_DISABLED = 0
+ZERO_OPTIMIZATION_OPTIMIZER_STATES = 1
+ZERO_OPTIMIZATION_GRADIENTS = 2
+ZERO_OPTIMIZATION_WEIGHTS = 3
+MAX_STAGE_ZERO_OPTIMIZATION = ZERO_OPTIMIZATION_WEIGHTS
+
+ZERO_OPTIMIZATION_STAGE = "stage"
+ZERO_OPTIMIZATION_STAGE_DEFAULT = ZERO_OPTIMIZATION_DISABLED
+
+ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS = "allgather_partitions"
+ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT = True
+
+ZERO_OPTIMIZATION_REDUCE_SCATTER = "reduce_scatter"
+ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT = True
+
+ZERO_OPTIMIZATION_OVERLAP_COMM = "overlap_comm"
+ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT = False
+
+ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS = "contiguous_gradients"
+ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT = False
+
+ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
+ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT = 500000000
+
+ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE = "allgather_bucket_size"
+ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT = 500000000
+
+ZERO_OPTIMIZATION_CPU_OFFLOAD = "cpu_offload"
+ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT = False
+
+ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT = "elastic_checkpoint"
+ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT = True
+
+ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS = "load_from_fp32_weights"
+ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
+
+ZERO_OPTIMIZATION_DEFAULT = {
+    ZERO_OPTIMIZATION_STAGE: ZERO_OPTIMIZATION_STAGE_DEFAULT,
+}
+
+
+class DeepSpeedZeroConfig:
+    def __init__(self, param_dict):
+        self.stage = None
+        self.contiguous_gradients = None
+        self.reduce_scatter = None
+        self.reduce_bucket_size = None
+        self.allgather_partitions = None
+        self.allgather_bucket_size = None
+        self.overlap_comm = None
+        self.load_from_fp32_weights = None
+        self.cpu_offload = None
+        self.elastic_checkpoint = None
+
+        if ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[ZERO_OPTIMIZATION]
+            if isinstance(zero_config_dict, bool):
+                zero_config_dict = {
+                    ZERO_OPTIMIZATION_STAGE:
+                    1 if zero_config_dict else 0
+                }
+        else:
+            zero_config_dict = ZERO_OPTIMIZATION_DEFAULT
+        self._initialize(zero_config_dict)
+
+    def _initialize(self, d):
+        self.stage = get_scalar_param(d, ZERO_OPTIMIZATION_STAGE,
+                                      ZERO_OPTIMIZATION_STAGE_DEFAULT)
+        assert 0 <= self.stage <= MAX_STAGE_ZERO_OPTIMIZATION, \
+            f"zero_optimization.stage must be in [0,{MAX_STAGE_ZERO_OPTIMIZATION}]"
+        self.contiguous_gradients = get_scalar_param(
+            d, ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS,
+            ZERO_OPTIMIZATION_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = get_scalar_param(
+            d, ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE,
+            ZERO_OPTIMIZATION_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.reduce_scatter = get_scalar_param(
+            d, ZERO_OPTIMIZATION_REDUCE_SCATTER,
+            ZERO_OPTIMIZATION_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = get_scalar_param(
+            d, ZERO_OPTIMIZATION_OVERLAP_COMM,
+            ZERO_OPTIMIZATION_OVERLAP_COMM_DEFAULT)
+        self.allgather_partitions = get_scalar_param(
+            d, ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS,
+            ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT)
+        self.allgather_bucket_size = get_scalar_param(
+            d, ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE,
+            ZERO_OPTIMIZATION_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.load_from_fp32_weights = get_scalar_param(
+            d, ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS,
+            ZERO_OPTIMIZATION_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+        self.cpu_offload = get_scalar_param(
+            d, ZERO_OPTIMIZATION_CPU_OFFLOAD,
+            ZERO_OPTIMIZATION_CPU_OFFLOAD_DEFAULT)
+        self.elastic_checkpoint = get_scalar_param(
+            d, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
+            ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
+
+    def repr(self):
+        return dict(stage=self.stage,
+                    contiguous_gradients=self.contiguous_gradients,
+                    reduce_scatter=self.reduce_scatter,
+                    reduce_bucket_size=self.reduce_bucket_size,
+                    allgather_partitions=self.allgather_partitions,
+                    allgather_bucket_size=self.allgather_bucket_size,
+                    overlap_comm=self.overlap_comm,
+                    load_from_fp32_weights=self.load_from_fp32_weights,
+                    cpu_offload=self.cpu_offload,
+                    elastic_checkpoint=self.elastic_checkpoint)
+
+    def __repr__(self):
+        return str(self.repr())

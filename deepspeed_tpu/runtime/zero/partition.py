@@ -1,0 +1,142 @@
+"""ZeRO stages 1/2/3 as GSPMD sharding policies.
+
+TPU-native redesign of `deepspeed/runtime/zero/stage1.py` (1121 LoC) and
+`stage2.py` (1855 LoC).  The reference implements partitioning imperatively:
+flattened fp32 sub-partitions, per-param backward hooks filling contiguous
+IPG buckets, hand-rolled async reduce-scatter to partition owners, and a
+post-step sharded all-gather.  Under XLA/GSPMD every one of those behaviors
+is a *sharding annotation*:
+
+  stage 1  optimizer state (fp32 masters + moments) carries a
+           PartitionSpec over the `data` axis → XLA reduce-scatters grads
+           into the update and all-gathers updated params, exactly the
+           stage-1 comm pattern (ref `stage1.py:572,624`), scheduled and
+           overlapped by the XLA latency-hiding scheduler (replacing
+           `overlap_comm` side streams, ref `stage2.py:676-682`).
+  stage 2  gradient-accumulation buffers also carry the data-axis spec, so
+           cross-microbatch grads live sharded — the IPG-bucket machinery
+           (ref `stage2.py:613-738`) with none of the hooks.
+  stage 3  parameters themselves are stored sharded and all-gathered
+           on use (FSDP); the reference never shipped this
+           (`engine.py:709-710` raises NotImplementedError) — on TPU it
+           falls out of the same annotation mechanism.
+
+The policy below picks, per array, the largest dimension divisible by the
+data-axis size (GSPMD requires no padding bookkeeping — the reference's
+alignment/padding logic, `stage1.py:198-261`, has no analogue here).
+Leaves too small to shard stay replicated, mirroring the reference's
+handling of sub-partition remainders.
+"""
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.mesh import DATA_AXIS
+
+
+def _best_shard_dim(shape, axis_size) -> Optional[int]:
+    """Largest dim evenly divisible by axis_size; None if no dim qualifies."""
+    best, best_size = None, 0
+    for d, s in enumerate(shape):
+        if s % axis_size == 0 and s >= axis_size and s > best_size:
+            best, best_size = d, s
+    return best
+
+
+def leaf_data_spec(leaf, axis_size, existing_spec=None) -> PartitionSpec:
+    """PartitionSpec sharding one dim of `leaf` over the data axis,
+    composing with an existing (e.g. tensor-parallel) spec if given."""
+    shape = np.shape(leaf)
+    base = list(existing_spec) if existing_spec is not None else []
+    base += [None] * (len(shape) - len(base))
+    if axis_size <= 1:
+        return PartitionSpec(*base)
+    # Only consider dims not already taken by another axis.
+    candidates = [(d, s) for d, s in enumerate(shape)
+                  if base[d] is None and s % axis_size == 0 and s >= axis_size]
+    if not candidates:
+        return PartitionSpec(*base)
+    d = max(candidates, key=lambda t: t[1])[0]
+    base[d] = DATA_AXIS
+    return PartitionSpec(*base)
+
+
+class ZeroShardingPolicy:
+    """Maps ZeRO stage → shardings for each state group.
+
+    param_specs: optional pytree of PartitionSpecs carrying tensor-parallel
+    placement (model axis); data-axis sharding composes on top.
+    """
+
+    def __init__(self, mesh: Mesh, stage: int, param_specs=None):
+        assert 0 <= stage <= 3
+        self.mesh = mesh
+        self.stage = stage
+        self.dp_size = mesh.shape[DATA_AXIS]
+        self.param_specs = param_specs
+
+    # -- spec builders ----------------------------------------------------
+    def _tp_spec_for(self, path_spec, leaf):
+        if path_spec is None:
+            return PartitionSpec(*([None] * np.ndim(leaf)))
+        return path_spec
+
+    def _specs(self, params, shard_over_data: bool):
+        def one(leaf, tp_spec):
+            if np.ndim(leaf) == 0:
+                return PartitionSpec()
+            if shard_over_data:
+                return leaf_data_spec(leaf, self.dp_size, tp_spec)
+            return self._tp_spec_for(tp_spec, leaf)
+
+        if self.param_specs is None:
+            return jax.tree_util.tree_map(lambda l: one(l, None), params)
+        return jax.tree_util.tree_map(one, params, self.param_specs)
+
+    # -- public: per-group PartitionSpec pytrees -------------------------
+    def param_pspecs(self, params):
+        """Compute-dtype parameters: sharded only at stage 3 (FSDP)."""
+        return self._specs(params, shard_over_data=self.stage >= 3)
+
+    def master_pspecs(self, params):
+        """fp32 master copies + optimizer moments: sharded at stage >= 1."""
+        return self._specs(params, shard_over_data=self.stage >= 1)
+
+    def grad_accum_pspecs(self, params):
+        """Cross-microbatch gradient accumulators: sharded at stage >= 2."""
+        return self._specs(params, shard_over_data=self.stage >= 2)
+
+    # -- NamedSharding versions ------------------------------------------
+    def _named(self, pspecs):
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(self.mesh, s), pspecs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def param_shardings(self, params):
+        return self._named(self.param_pspecs(params))
+
+    def master_shardings(self, params):
+        return self._named(self.master_pspecs(params))
+
+    def grad_accum_shardings(self, params):
+        return self._named(self.grad_accum_pspecs(params))
+
+    def opt_state_shardings(self, opt_state, params):
+        """Optimizer state: leaves that mirror a param shape get that
+        param's master sharding; everything else (counts, scalars) is
+        replicated."""
+        master = self.master_pspecs(params)
+        shape_to_spec = {}
+        for spec, leaf in zip(jax.tree_util.tree_leaves(
+                master, is_leaf=lambda x: isinstance(x, PartitionSpec)),
+                jax.tree_util.tree_leaves(params)):
+            shape_to_spec.setdefault(np.shape(leaf), spec)
+
+        def one(leaf):
+            spec = shape_to_spec.get(np.shape(leaf), PartitionSpec())
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map(one, opt_state)

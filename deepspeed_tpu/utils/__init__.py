@@ -1,0 +1,4 @@
+from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer,
+                                       ThroughputTimer)
+from deepspeed_tpu.utils.distributed import init_distributed

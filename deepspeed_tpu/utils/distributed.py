@@ -1,0 +1,99 @@
+"""Distributed initialization.
+
+TPU-native analogue of `deepspeed/utils/distributed.py:12` — the NCCL
+rendezvous becomes `jax.distributed.initialize` (coordinator + process
+index/count). On a TPU pod the three values auto-resolve from the TPU
+environment, so plain `init_distributed()` works with no env plumbing; the
+env-var path (MASTER_ADDR/PORT, RANK, WORLD_SIZE) is honored for parity
+with the reference's launcher contract.
+"""
+
+import os
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def init_distributed(dist_backend="xla",
+                     auto_mpi_discovery=True,
+                     distributed_port=29500,
+                     verbose=True,
+                     timeout=None,
+                     init_method=None):
+    """Initialize multi-host JAX. Safe to call when single-host (no-op).
+
+    Must run before any other JAX call (jax.distributed.initialize
+    requirement) — so this reads only the environment until the decision
+    to initialize is made.
+    """
+    coordinator = os.environ.get("MASTER_ADDR")
+    num_processes = os.environ.get("WORLD_SIZE")
+    process_id = os.environ.get("RANK")
+
+    if auto_mpi_discovery and coordinator is None and \
+            in_mpi_environment():
+        mpi_discovery(distributed_port=distributed_port, verbose=verbose)
+        coordinator = os.environ.get("MASTER_ADDR")
+        num_processes = os.environ.get("WORLD_SIZE")
+        process_id = os.environ.get("RANK")
+
+    kwargs = {}
+    if coordinator is not None:
+        port = os.environ.get("MASTER_PORT", str(distributed_port))
+        kwargs["coordinator_address"] = f"{coordinator}:{port}"
+    if num_processes is not None:
+        kwargs["num_processes"] = int(num_processes)
+    if process_id is not None:
+        kwargs["process_id"] = int(process_id)
+
+    import jax
+    if not kwargs and int(num_processes or 1) <= 1 and \
+            "TPU_WORKER_HOSTNAMES" not in os.environ:
+        return  # explicit single-process run; leave JAX untouched
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            logger.warning("jax.distributed already initialized; skipping")
+        elif not kwargs:
+            return  # auto-resolution found nothing; single-process run
+        else:
+            raise
+    if verbose:
+        logger.info(
+            f"Initialized distributed: process {jax.process_index()}/"
+            f"{jax.process_count()}, {jax.device_count()} global devices")
+
+
+def in_mpi_environment():
+    return "OMPI_COMM_WORLD_RANK" in os.environ or \
+        "PMI_RANK" in os.environ
+
+
+def mpi_discovery(distributed_port=29500, verbose=True):
+    """Derive MASTER_ADDR/RANK/WORLD_SIZE from an MPI launch (ref
+    `distributed.py:54-95`), via env vars (OpenMPI/PMI) without requiring
+    mpi4py."""
+    rank = os.environ.get("OMPI_COMM_WORLD_RANK",
+                          os.environ.get("PMI_RANK", "0"))
+    world_size = os.environ.get("OMPI_COMM_WORLD_SIZE",
+                                os.environ.get("PMI_SIZE", "1"))
+    master_addr = os.environ.get("MASTER_ADDR")
+    if master_addr is None:
+        try:
+            from mpi4py import MPI
+            comm = MPI.COMM_WORLD
+            import socket
+            master_addr = comm.bcast(socket.gethostbyname(socket.gethostname())
+                                     if comm.Get_rank() == 0 else None, root=0)
+        except ImportError:
+            master_addr = "127.0.0.1"
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(distributed_port)
+    os.environ["RANK"] = rank
+    os.environ["WORLD_SIZE"] = world_size
+    os.environ.setdefault("LOCAL_RANK",
+                          os.environ.get("OMPI_COMM_WORLD_LOCAL_RANK", "0"))
+    if verbose:
+        logger.info(
+            f"MPI discovery: rank={rank} world_size={world_size} "
+            f"master_addr={master_addr} master_port={distributed_port}")

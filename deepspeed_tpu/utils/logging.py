@@ -1,0 +1,51 @@
+"""Framework logger + rank-filtered logging.
+
+TPU-native analogue of the reference's `deepspeed/utils/logging.py:7,40`:
+one shared `logger`, and `log_dist(message, ranks)` which only emits on the
+listed process indices (JAX multi-controller: `jax.process_index()`).
+"""
+
+import logging
+import sys
+import functools
+
+
+class LoggerFactory:
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(name="DeepSpeedTPU", level=logging.INFO)
+
+
+@functools.lru_cache(maxsize=None)
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log `message` only if the current process index is in `ranks`.
+
+    ranks=None or [-1] means: log on every process.
+    """
+    my_rank = _process_index()
+    should_log = ranks is None or (-1 in ranks) or (my_rank in ranks)
+    if should_log:
+        logger.log(level, f"[Rank {my_rank}] {message}")

@@ -1,0 +1,36 @@
+"""Test harness: single-process multi-device on CPU.
+
+The reference forks N processes with real NCCL per distributed test
+(`tests/unit/common.py:16-104`); the TPU-native equivalent is an 8-device
+virtual CPU mesh in one process (SURVEY §4). Must set XLA flags before
+jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# The container's sitecustomize pins jax_platforms to the TPU plugin before
+# conftest runs; override it after import (env alone is not enough).
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, jax.devices()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def mesh8():
+    """2-axis (data=8) mesh over the virtual devices."""
+    from deepspeed_tpu.runtime.mesh import build_mesh
+    return build_mesh({"pipe": 1, "data": 8, "model": 1})
+
+
+@pytest.fixture
+def tmp_ckpt_dir(tmp_path):
+    return str(tmp_path / "ckpt")

@@ -1,0 +1,37 @@
+"""Test model fixtures (analogue of ref tests/unit/simple_model.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Linear + MSE regression; engine-protocol object with loss_fn."""
+
+    def __init__(self, hidden_dim=16, seed=0):
+        self.hidden_dim = hidden_dim
+        rng = np.random.RandomState(seed)
+        self.params = {
+            "w": jnp.asarray(rng.randn(hidden_dim, hidden_dim) * 0.1,
+                             jnp.float32),
+            "b": jnp.zeros((hidden_dim,), jnp.float32),
+        }
+
+    def loss_fn(self, params, batch, rngs=None, deterministic=False):
+        x, y = batch["x"], batch["y"]
+        pred = x.astype(jnp.float32) @ params["w"] + params["b"]
+        return jnp.mean((pred - y.astype(jnp.float32)) ** 2)
+
+
+def random_dataset(total_samples, hidden_dim, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(total_samples, hidden_dim).astype(np.float32)
+    w_true = rng.randn(hidden_dim, hidden_dim).astype(np.float32)
+    y = x @ w_true
+    return [{"x": x[i], "y": y[i]} for i in range(total_samples)]
+
+
+def random_token_batch(batch_size, seq_len, vocab, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, size=(batch_size, seq_len)).astype(np.int32)
+    return {"input_ids": ids}

@@ -1,0 +1,149 @@
+"""Config tests (parity with ref tests/unit/test_config.py +
+test_ds_config.py: batch triple resolution, duplicate keys, zero block)."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.config_utils import load_config_dict
+
+
+def base_config(**over):
+    cfg = {"train_batch_size": 32, "gradient_accumulation_steps": 2}
+    cfg.update(over)
+    return cfg
+
+
+def test_batch_triple_all_given():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4,
+         "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_batch_size == 64
+    assert cfg.train_micro_batch_size_per_gpu == 4
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_infer_gas():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4},
+        world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triple_infer_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 2},
+        world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_batch_triple_infer_train():
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 4,
+         "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_batch_size == 64
+
+
+def test_batch_triple_only_train():
+    cfg = DeepSpeedConfig({"train_batch_size": 64}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 8
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triple_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 5,
+             "gradient_accumulation_steps": 2}, world_size=8)
+
+
+def test_batch_triple_none_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_duplicate_json_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        load_config_dict(str(p))
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(base_config()))
+    cfg = DeepSpeedConfig(str(p), world_size=4)
+    assert cfg.train_batch_size == 32
+    assert cfg.train_micro_batch_size_per_gpu == 4
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig(base_config(), world_size=1)
+    assert cfg.zero_optimization_stage == 0
+    assert not cfg.zero_enabled
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages(stage):
+    cfg = DeepSpeedConfig(
+        base_config(zero_optimization={"stage": stage}), world_size=1)
+    assert cfg.zero_optimization_stage == stage
+    assert cfg.zero_enabled == (stage > 0)
+
+
+def test_zero_stage_too_high():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            base_config(zero_optimization={"stage": 4}), world_size=1)
+
+
+def test_fp16_block():
+    cfg = DeepSpeedConfig(
+        base_config(fp16={"enabled": True, "loss_scale": 0,
+                          "initial_scale_power": 16,
+                          "loss_scale_window": 500, "hysteresis": 2,
+                          "min_loss_scale": 1}), world_size=1)
+    assert cfg.fp16_enabled
+    assert cfg.initial_dynamic_scale == 2**16
+    assert cfg.dynamic_loss_scale_args["scale_window"] == 500
+
+
+def test_bf16_block():
+    cfg = DeepSpeedConfig(base_config(bf16={"enabled": True}), world_size=1)
+    assert cfg.bfloat16_enabled
+    assert not cfg.fp16_enabled
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            base_config(fp16={"enabled": True}, bf16={"enabled": True}),
+            world_size=1)
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig(
+        base_config(
+            optimizer={"type": "Adam", "params": {"lr": 0.015}},
+            scheduler={"type": "WarmupLR",
+                       "params": {"warmup_num_steps": 10}}), world_size=1)
+    assert cfg.optimizer_name == "adam"
+    assert cfg.optimizer_params["lr"] == 0.015
+    assert cfg.scheduler_name == "WarmupLR"
+
+
+def test_gradient_clipping_key():
+    cfg = DeepSpeedConfig(base_config(gradient_clipping=1.0), world_size=1)
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_checkpoint_tag_validation_modes():
+    cfg = DeepSpeedConfig(
+        base_config(checkpoint={"tag_validation": "FAIL"}), world_size=1)
+    assert cfg.checkpoint_tag_validation_enabled
+    assert cfg.checkpoint_tag_validation_fail
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            base_config(checkpoint={"tag_validation": "bogus"}),
+            world_size=1)

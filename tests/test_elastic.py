@@ -1,0 +1,107 @@
+"""Elasticity tests (parity with ref tests/unit/test_elastic.py)."""
+
+import pytest
+
+from deepspeed_tpu import elasticity
+from deepspeed_tpu.version import __version__
+
+base_ds_config = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_10k():
+    final_batch_size, valid_gpus = elasticity.compute_elastic_config(
+        ds_config=base_ds_config, target_deepspeed_version=__version__)
+    for gpu_num in valid_gpus:
+        assert final_batch_size % gpu_num == 0
+        batch_per_gpu = final_batch_size // gpu_num
+        found_valid_mbsize = any(
+            batch_per_gpu % mb == 0
+            for mb in base_ds_config["elasticity"]["micro_batch_sizes"])
+        assert found_valid_mbsize, f"No valid mb for gpu count {gpu_num}"
+
+
+def test_candidate_batch_sizes_hcn():
+    # base 1 scales to the largest HCN <= ceiling
+    assert elasticity.get_candidate_batch_sizes([1], 720) == [720]
+    # base 2 -> 2*48=96; base 3 -> 3*24=72 (3*36 exceeds 100)
+    assert set(elasticity.get_candidate_batch_sizes([2, 3], 100)) == {96, 72}
+
+
+def test_valid_gpus_divisors():
+    gpus = elasticity.get_valid_gpus(24, [2, 3], 1, 100)
+    # batch 24, micro 2 -> q=12: 1,2,3,4,6,12; micro 3 -> q=8: 1,2,4,8
+    assert gpus == [1, 2, 3, 4, 6, 8, 12]
+
+
+def test_world_size_picks_micro_batch():
+    ds_config = {
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2000,
+            "micro_batch_sizes": [2, 4],
+            "min_gpus": 1,
+            "max_gpus": 100,
+            "version": 0.1,
+        }
+    }
+    fbs, valid, micro = elasticity.compute_elastic_config(
+        ds_config=ds_config, target_deepspeed_version=__version__,
+        world_size=4)
+    assert 4 in valid
+    assert (fbs // 4) % micro == 0
+
+
+def test_disabled_raises():
+    cfg = {"elasticity": {"enabled": False}}
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config=cfg, target_deepspeed_version=__version__)
+
+
+def test_missing_block_raises():
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config={}, target_deepspeed_version=__version__)
+
+
+def test_invalid_version_raises():
+    cfg = {"elasticity": dict(base_ds_config["elasticity"], version=0.2)}
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.compute_elastic_config(
+            ds_config=cfg, target_deepspeed_version=__version__)
+
+
+def test_old_deepspeed_version_raises():
+    with pytest.raises(elasticity.ElasticityError):
+        elasticity.compute_elastic_config(
+            ds_config=base_ds_config, target_deepspeed_version="0.2.0")
+
+
+def test_incompatible_world_size():
+    with pytest.raises(elasticity.ElasticityIncompatibleWorldSize):
+        elasticity.compute_elastic_config(
+            ds_config=base_ds_config,
+            target_deepspeed_version=__version__,
+            world_size=31)  # below min_gpus
+
+
+def test_config_missing_fields():
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.ElasticityConfig({"enabled": True})
+
+
+def test_config_bad_micro_batches():
+    with pytest.raises(elasticity.ElasticityConfigError):
+        elasticity.ElasticityConfig({
+            "enabled": True, "max_train_batch_size": 100,
+            "micro_batch_sizes": [0, 2]})

@@ -1,0 +1,94 @@
+"""Dynamic loss scale tests (parity with ref
+tests/unit/test_dynamic_loss_scale.py: exact halving/raising schedules)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler, LossScaler, make_loss_scale_state, update_loss_scale)
+
+
+def run_automaton(state, overflows, **kw):
+    scales = []
+    for ov in overflows:
+        state = update_loss_scale(state, ov, **kw)
+        scales.append(float(state.loss_scale))
+    return state, scales
+
+
+def test_scale_doubles_after_window():
+    state = make_loss_scale_state(init_scale=256.0, delayed_shift=1)
+    _, scales = run_automaton(state, [False] * 4, scale_window=2,
+                              delayed_shift=1)
+    assert scales == [256.0, 512.0, 512.0, 1024.0]
+
+
+def test_scale_halves_on_overflow():
+    state = make_loss_scale_state(init_scale=256.0, delayed_shift=1)
+    _, scales = run_automaton(state, [True, True, False], scale_window=1000,
+                              delayed_shift=1)
+    assert scales[0] == 128.0
+    assert scales[1] == 64.0
+    assert scales[2] == 64.0
+
+
+def test_hysteresis_delays_drop():
+    state = make_loss_scale_state(init_scale=256.0, delayed_shift=2)
+    # first overflow burns hysteresis, second drops the scale
+    _, scales = run_automaton(state, [True, True], scale_window=1000,
+                              delayed_shift=2)
+    assert scales[0] == 256.0
+    assert scales[1] == 128.0
+
+
+def test_min_scale_floor():
+    state = make_loss_scale_state(init_scale=2.0, delayed_shift=1)
+    _, scales = run_automaton(state, [True] * 5, scale_window=1000,
+                              min_scale=1.0, delayed_shift=1)
+    assert scales[-1] == 1.0
+
+
+def test_overflow_resets_good_steps():
+    state = make_loss_scale_state(init_scale=256.0, delayed_shift=1)
+    # 1 clean, overflow, then window clean steps must elapse before growth
+    state = update_loss_scale(state, False, scale_window=3, delayed_shift=1)
+    state = update_loss_scale(state, True, scale_window=3, delayed_shift=1)
+    assert float(state.loss_scale) == 128.0
+    for _ in range(2):
+        state = update_loss_scale(state, False, scale_window=3,
+                                  delayed_shift=1)
+    assert float(state.loss_scale) == 128.0
+    state = update_loss_scale(state, False, scale_window=3, delayed_shift=1)
+    assert float(state.loss_scale) == 256.0
+
+
+def test_update_is_jittable():
+    @jax.jit
+    def step(state, ov):
+        return update_loss_scale(state, ov, scale_window=2, delayed_shift=1)
+
+    state = make_loss_scale_state(init_scale=16.0, delayed_shift=1)
+    state = step(state, jnp.asarray(False))
+    state = step(state, jnp.asarray(False))
+    assert float(state.loss_scale) == 32.0
+
+
+def test_host_dynamic_scaler_matches_automaton():
+    """Host-side class and device automaton agree on a mixed trace."""
+    trace = [False, False, True, False, True, True, False, False]
+    host = DynamicLossScaler(init_scale=64.0, scale_window=2,
+                             delayed_shift=1, min_scale=1)
+    dev = make_loss_scale_state(init_scale=64.0, delayed_shift=1)
+    for ov in trace:
+        host.update_scale(ov)
+        dev = update_loss_scale(dev, ov, scale_window=2, min_scale=1,
+                                delayed_shift=1)
+    assert float(dev.loss_scale) == float(host.cur_scale)
+
+
+def test_static_scaler():
+    s = LossScaler(scale=128.0)
+    assert s.loss_scale == 128.0
+    s.update_scale(True)
+    assert s.loss_scale == 128.0

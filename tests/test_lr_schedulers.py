@@ -1,0 +1,107 @@
+"""LR schedule tests (parity with ref tests/unit/test_lr_schedulers.py)."""
+
+import math
+
+import pytest
+
+from deepspeed_tpu.runtime import lr_schedules
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle,
+                                                WarmupLR, WarmupDecayLR,
+                                                _OptimizerShim)
+
+
+def test_warmup_lr_values():
+    opt = _OptimizerShim(lr=0.0)
+    sched = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.1,
+                     warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        sched.step()
+        lrs.append(sched.get_last_lr()[0])
+    # warmup is log-shaped, monotonic, reaching max at warmup_num_steps
+    assert all(b >= a for a, b in zip(lrs, lrs[1:]))
+    assert lrs[9] == pytest.approx(0.1)
+    assert lrs[-1] == pytest.approx(0.1)
+
+
+def test_warmup_decay_lr():
+    opt = _OptimizerShim(lr=0.0)
+    sched = WarmupDecayLR(opt, total_num_steps=20, warmup_min_lr=0.0,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = []
+    for _ in range(21):
+        sched.step()
+        lrs.append(sched.get_last_lr()[0])
+    assert lrs[9] == pytest.approx(0.1)
+    # linear decay after warmup, hitting 0 at iteration == total_num_steps
+    assert lrs[-1] == pytest.approx(0.0, abs=1e-12)
+    assert lrs[14] < lrs[9]
+
+
+def test_lr_range_test_continuous():
+    opt = _OptimizerShim(lr=0.0)
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=5,
+                        lr_range_test_step_rate=1.0)
+    sched.step()
+    first = sched.get_last_lr()[0]
+    for _ in range(9):
+        sched.step()
+    later = sched.get_last_lr()[0]
+    assert later > first
+    # continuous growth: lr = min_lr * (1 + rate * it/step_size)
+    assert later == pytest.approx(0.01 * (1 + 10 / 5))
+
+
+def test_lr_range_test_staircase():
+    opt = _OptimizerShim(lr=0.0)
+    sched = LRRangeTest(opt, lr_range_test_min_lr=0.01,
+                        lr_range_test_step_size=5,
+                        lr_range_test_step_rate=1.0,
+                        lr_range_test_staircase=True)
+    vals = []
+    for _ in range(10):
+        sched.step()
+        vals.append(sched.get_last_lr()[0])
+    assert vals[0] == vals[3]  # flat within a stair
+    assert vals[5] > vals[4] or vals[4] > vals[0]
+
+
+def test_one_cycle_shape():
+    opt = _OptimizerShim(lr=0.0)
+    sched = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                     cycle_first_step_size=10, cycle_momentum=False)
+    lrs = []
+    for _ in range(20):
+        sched.step()
+        lrs.append(sched.get_last_lr()[0])
+    peak_idx = lrs.index(max(lrs))
+    assert 8 <= peak_idx <= 11
+    assert lrs[0] < lrs[peak_idx]
+    assert lrs[-1] < lrs[peak_idx]
+
+
+def test_scheduler_state_dict_roundtrip():
+    opt = _OptimizerShim(lr=0.0)
+    s1 = WarmupLR(opt, warmup_max_lr=0.1, warmup_num_steps=10)
+    for _ in range(5):
+        s1.step()
+    sd = s1.state_dict()
+    s2 = WarmupLR(_OptimizerShim(lr=0.0), warmup_max_lr=0.1,
+                  warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    s1.step()
+    s2.step()
+    assert s1.get_last_lr() == s2.get_last_lr()
+
+
+def test_get_config_from_args():
+    import argparse
+    parser = argparse.ArgumentParser()
+    parser = lr_schedules.add_tuning_arguments(parser)
+    args = parser.parse_args(["--lr_schedule", "WarmupLR",
+                              "--warmup_num_steps", "50"])
+    config, err = lr_schedules.get_config_from_args(args)
+    assert err is None
+    assert config["type"] == "WarmupLR"
+    assert config["params"]["warmup_num_steps"] == 50
