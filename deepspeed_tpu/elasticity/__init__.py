@@ -150,7 +150,9 @@ def get_best_candidates(candidate_batch_sizes, micro_batches, min_gpus,
             (bs > best_bs if prefer_larger else bs < best_bs))
         if better:
             best_count, best_gpus, best_bs = len(gpus), gpus, bs
-    return best_bs, best_gpus
+    # No candidate admits any valid device count: return an empty list so
+    # callers raise ElasticityIncompatibleWorldSize, not TypeError.
+    return best_bs, best_gpus if best_gpus is not None else []
 
 
 def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size,

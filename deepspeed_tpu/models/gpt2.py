@@ -59,7 +59,7 @@ GPT2_SIZES = {
     "gpt2-1.5b": dict(n_layer=48, n_embd=1600, n_head=25),
     "gpt2-2.7b": dict(n_layer=32, n_embd=2560, n_head=32),
     "gpt2-6.7b": dict(n_layer=32, n_embd=4096, n_head=32),
-    "gpt2-13b": dict(n_layer=40, n_embd=5140, n_head=40),
+    "gpt2-13b": dict(n_layer=40, n_embd=5120, n_head=40),
 }
 
 

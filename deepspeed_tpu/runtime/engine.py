@@ -87,6 +87,17 @@ def _zeros_like_f32(tree):
         lambda x: jnp.zeros(x.shape, jnp.float32), tree)
 
 
+def _fetch_to_host(tree):
+    """device_get that also handles multi-host (non-fully-addressable)
+    sharded arrays by all-gathering them across processes first."""
+    def one(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return jax.device_get(x)
+    return jax.tree_util.tree_map(one, tree)
+
+
 class DeepSpeedEngine:
     """TPU training engine.
 
@@ -165,6 +176,10 @@ class DeepSpeedEngine:
             self.summary_writer = self.get_summary_writer()
 
         self.micro_steps = 0
+        # Host-side mirror of the device step counter: used for print/log
+        # gating so the hot loop never blocks on device_get (the device
+        # counters remain authoritative for checkpointing).
+        self._host_steps = 0
         self._pending_grads = None
         self._pending_loss = None
         self.losses = None
@@ -204,13 +219,34 @@ class DeepSpeedEngine:
         if hasattr(model, "loss_fn"):
             self._loss_fn = model.loss_fn
         elif hasattr(model, "apply"):  # bare flax module returning loss
-            def _flax_loss(params, batch, rngs=None, deterministic=False):
+            import inspect
+            try:
+                accepted = set(
+                    inspect.signature(type(model).__call__).parameters)
+            except (TypeError, ValueError):
+                accepted = set()
+
+            def _flax_loss(params, batch, rngs=None, deterministic=False,
+                           **kwargs):
+                kw = {k: v for k, v in kwargs.items() if k in accepted}
+                if "deterministic" in accepted:
+                    kw["deterministic"] = deterministic
                 return model.apply({"params": params}, batch,
-                                   rngs=rngs or {})
+                                   rngs=rngs or {}, **kw)
             self._loss_fn = _flax_loss
         elif callable(model):
-            def _callable_loss(params, batch, rngs=None, deterministic=False):
-                return model(params, batch, rngs)
+            import inspect
+            try:
+                accepted = set(inspect.signature(model).parameters)
+            except (TypeError, ValueError):
+                accepted = set()
+
+            def _callable_loss(params, batch, rngs=None, deterministic=False,
+                               **kwargs):
+                kw = {k: v for k, v in kwargs.items() if k in accepted}
+                if "deterministic" in accepted:
+                    kw["deterministic"] = deterministic
+                return model(params, batch, rngs, **kw)
             self._loss_fn = _callable_loss
         else:
             raise TypeError(f"cannot adapt model of type {type(model)}")
@@ -484,6 +520,13 @@ class DeepSpeedEngine:
 
         opt_target = master if self.mixed_precision else params
         opt_state = self.optimizer_transform.init(opt_target)
+        if self.lr_scheduler is not None and self._base_lr is None and \
+                "learning_rate" not in getattr(opt_state, "hyperparams", {}):
+            logger.warning(
+                "an LR scheduler is configured but the client optimizer "
+                "exposes no injectable 'learning_rate' hyperparam "
+                "(wrap it with optax.inject_hyperparams); scheduler values "
+                "will not be applied")
         self._opt_shardings = self.zero_policy.opt_state_shardings(
             opt_state, params_f32)
         opt_state = jax.device_put(opt_state, self._opt_shardings)
@@ -602,7 +645,11 @@ class DeepSpeedEngine:
         return new_state, overflow, grad_norm
 
     def _with_lr(self, opt_state, lr):
-        """Override injected learning_rate hyperparam with a traced scalar."""
+        """Override injected learning_rate hyperparam with a traced scalar.
+        lr=None (client optimizer with no scheduler) leaves the client's
+        own learning rate untouched."""
+        if lr is None:
+            return opt_state
         if hasattr(opt_state, "hyperparams") and \
                 "learning_rate" in opt_state.hyperparams:
             hp = dict(opt_state.hyperparams)
@@ -712,7 +759,7 @@ class DeepSpeedEngine:
             self.timers(FORWARD_MICRO_TIMER).start()
             self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.progressive_layer_drop is not None:
-            self.progressive_layer_drop.update_state(self.global_steps)
+            self.progressive_layer_drop.update_state(self._host_steps)
         batch = self._shard_batch(batch)
         loss, grads = self._micro_grad_jit(
             self.state.params, batch, self._next_rng(),
@@ -754,7 +801,7 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
             self.timers(STEP_GLOBAL_TIMER).stop()
-            if self.global_steps % self.steps_per_print() == 0:
+            if self._host_steps % self.steps_per_print() == 0:
                 self.timers.log([
                     FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER,
                     STEP_MICRO_TIMER
@@ -763,13 +810,17 @@ class DeepSpeedEngine:
     def _take_model_step(self, lr_kwargs=None):
         lr = self._next_lr()
         self.state, overflow, grad_norm = self._apply_jit(self.state, lr)
+        self._host_steps += 1
         self._after_model_step(overflow)
 
     def _next_lr(self):
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
             return float(self.lr_scheduler.get_last_lr()[0])
-        return float(self._base_lr or 0.0)
+        if self._base_lr is None:
+            # Client optax optimizer: its own schedule/lr applies unchanged.
+            return None
+        return float(self._base_lr)
 
     def _after_model_step(self, overflow):
         if self.fp16_mode:
@@ -779,17 +830,17 @@ class DeepSpeedEngine:
                     self.lr_scheduler is not None:
                 self.lr_scheduler.step(
                     self.lr_scheduler.last_batch_iteration - 1)
-        if self.summary_writer is not None:
+        at_print = self._host_steps % self.steps_per_print() == 0
+        if self.summary_writer is not None and at_print:
             gs = self.global_steps
-            if gs % self.steps_per_print() == 0:
+            self.summary_writer.add_scalar(
+                "Train/Samples/lr", self._current_lr(),
+                gs * self.train_batch_size())
+            if self.fp16_mode:
                 self.summary_writer.add_scalar(
-                    "Train/Samples/lr", self._current_lr(),
+                    "Train/Samples/loss_scale", self.loss_scale(),
                     gs * self.train_batch_size())
-                if self.fp16_mode:
-                    self.summary_writer.add_scalar(
-                        "Train/Samples/loss_scale", self.loss_scale(),
-                        gs * self.train_batch_size())
-        if self.global_steps % self.steps_per_print() == 0:
+        if at_print:
             log_dist(
                 f"step={self.global_steps}, skipped={self.skipped_steps}, "
                 f"lr={self.get_lr()}, mom={self.get_mom()}", ranks=[0])
@@ -823,12 +874,14 @@ class DeepSpeedEngine:
         batch = jax.tree_util.tree_map(put_stacked, batch)
         lr = self._next_lr()
         if self.progressive_layer_drop is not None:
-            self.progressive_layer_drop.update_state(self.global_steps)
+            self.progressive_layer_drop.update_state(self._host_steps)
         self.state, loss, overflow, grad_norm = self._fused_step_jit(
             self.state, batch, self._next_rng(), lr, self._keep_prob())
         self.micro_steps += gas
+        self._host_steps += 1
         self._after_model_step(overflow)
-        self.tput_timer.stop()
+        # one fused step consumed `gas` microbatches worth of samples
+        self.tput_timer.stop(count=gas)
         self.losses = loss
         return loss
 
@@ -876,7 +929,7 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         sd = dict(
-            module=jax.device_get(self.fp32_params),
+            module=_fetch_to_host(self.fp32_params),
             global_steps=self.global_steps,
             skipped_steps=self.skipped_steps,
             micro_steps=self.micro_steps,
@@ -887,7 +940,7 @@ class DeepSpeedEngine:
         )
         sd.update(client_state or {})
         optim_sd = dict(
-            opt_state=jax.device_get(self.state.opt_state),
+            opt_state=_fetch_to_host(self.state.opt_state),
             scale=jax.device_get(self.state.scale),
             zero_stage=self.zero_optimization_stage(),
         )

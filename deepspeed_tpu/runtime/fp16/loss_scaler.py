@@ -74,12 +74,18 @@ def update_loss_scale(state: LossScaleState,
     grow = jnp.logical_and(~overflow, good % scale_window == 0)
     new_scale_on_clean = jnp.where(grow, state.loss_scale * scale_factor,
                                    state.loss_scale)
+    # A full clean window also restores hysteresis (reference resets
+    # cur_hysteresis to delayed_shift at every scale raise,
+    # `loss_scaler.py:155-157`).
+    new_hyst_on_clean = jnp.where(grow, jnp.asarray(delayed_shift, jnp.int32),
+                                  state.hysteresis)
 
     return LossScaleState(
         loss_scale=jnp.where(overflow, new_scale_on_overflow,
                              new_scale_on_clean),
         good_steps=jnp.where(overflow, jnp.asarray(0, jnp.int32), good),
-        hysteresis=jnp.where(overflow, new_hyst_on_overflow, state.hysteresis),
+        hysteresis=jnp.where(overflow, new_hyst_on_overflow,
+                             new_hyst_on_clean),
     )
 
 

@@ -138,12 +138,14 @@ class ThroughputTimer:
             _device_sync()
             self.start_time = time.time()
 
-    def stop(self, report_speed=True):
+    def stop(self, report_speed=True, count=1):
+        """`count` = microbatches consumed since start() (a fused
+        grad-accum step consumes several at once)."""
         if not self.started:
             return
         self.started = False
-        self.micro_step_count += 1
-        self.global_step_count += 1
+        self.micro_step_count += count
+        self.global_step_count += count
         if self.start_time > 0:
             _device_sync()
             self.end_time = time.time()

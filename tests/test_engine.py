@@ -255,3 +255,45 @@ def test_missing_checkpoint_returns_none(tmp_ckpt_dir):
         config=ds_config(train_batch_size=16))
     path, client = engine.load_checkpoint(tmp_ckpt_dir)
     assert path is None
+
+
+def test_client_optax_optimizer_lr_preserved():
+    """A client optax optimizer must keep its own learning rate (a past
+    bug forced it to 0.0, silently freezing training)."""
+    import optax
+    model = SimpleModel(hidden_dim=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        optimizer=optax.adam(5e-2),
+        config={"train_batch_size": 16, "steps_per_print": 100})
+    losses = train_steps(engine, 8)
+    assert losses[-1] < losses[0] * 0.9, \
+        f"client-optimizer training made no progress: {losses}"
+
+
+def test_bare_flax_model_eval_batch():
+    """Bare flax modules (with dropout) must work through eval_batch:
+    the adapter forwards `deterministic`."""
+    import flax.linen as nn
+
+    class LossModule(nn.Module):
+        @nn.compact
+        def __call__(self, batch, deterministic: bool = False):
+            h = nn.Dense(8)(batch["x"])
+            h = nn.Dropout(0.5)(h, deterministic=deterministic)
+            pred = nn.Dense(16)(h)
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+    model = LossModule()
+    batch = make_batch(16, 16, seed=0)
+    params = model.init({"params": jax.random.PRNGKey(0)}, batch,
+                        deterministic=True)["params"]
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config=ds_config())
+    loss = engine.eval_batch(batch)
+    assert np.isfinite(float(jax.device_get(loss)))
+    # training path (non-deterministic, needs dropout rng) also works
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()

@@ -92,3 +92,18 @@ def test_static_scaler():
     assert s.loss_scale == 128.0
     s.update_scale(True)
     assert s.loss_scale == 128.0
+
+
+def test_clean_window_restores_hysteresis():
+    """A full overflow-free window restores hysteresis to delayed_shift
+    (ref resets cur_hysteresis at every scale raise)."""
+    s = make_loss_scale_state(init_scale=2.0**10, delayed_shift=2)
+    s = update_loss_scale(s, True, scale_window=4, delayed_shift=2)
+    assert int(s.hysteresis) == 1
+    for _ in range(4):
+        s = update_loss_scale(s, False, scale_window=4, delayed_shift=2)
+    assert int(s.hysteresis) == 2
+    # a single overflow now only decrements hysteresis, not the scale
+    scale_before = float(s.loss_scale)
+    s = update_loss_scale(s, True, scale_window=4, delayed_shift=2)
+    assert float(s.loss_scale) == scale_before
