@@ -96,3 +96,9 @@ def add_config_arguments(parser):
     `__init__.py:193`)."""
     parser = _add_core_arguments(parser)
     return parser
+
+
+# Top-level re-exports (ref `__init__.py`: DeepSpeedTransformerLayer and
+# DeepSpeedTransformerConfig live at package root).
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
+                                           DeepSpeedTransformerConfig)

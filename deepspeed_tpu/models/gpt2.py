@@ -81,21 +81,11 @@ def _dense(features, config, name, init_scale=1.0):
 
 def causal_attention_xla(q, k, v, dropout_rng=None, dropout_rate=0.0,
                          deterministic=True):
-    """Plain XLA attention: fp32 softmax, causal mask via lower-tri bias."""
-    head_dim = q.shape[-1]
-    scale = 1.0 / np.sqrt(head_dim)
-    # [B, H, T, T]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    t = q.shape[1]
-    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
-    scores = jnp.where(mask[None, None, :, :], scores, jnp.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1)
-    if not deterministic and dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
-                                    probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
-    probs = probs.astype(v.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    """Plain XLA causal attention (shared dense_attention under the hood)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import dense_attention
+    return dense_attention(q, k, v, causal=True, dropout_rate=dropout_rate,
+                           dropout_rng=dropout_rng,
+                           deterministic=deterministic)
 
 
 def _attention(config, q, k, v, dropout_rng, deterministic):

@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
+                                           DeepSpeedTransformerConfig)
+
+__all__ = ["DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig"]

@@ -1,0 +1,7 @@
+from deepspeed_tpu.ops.transformer.transformer import (
+    DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
+from deepspeed_tpu.ops.transformer.flash_attention import (
+    flash_attention, flash_attention_usable)
+
+__all__ = ["DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+           "flash_attention", "flash_attention_usable"]

@@ -1,0 +1,399 @@
+"""Flash attention as a Pallas TPU kernel.
+
+TPU-native replacement for the reference's fused CUDA attention chain
+(`csrc/transformer/softmax_kernels.cu`, `strided_batch_gemm.h`,
+`ds_transformer_cuda.cpp:1026-1044`): instead of materializing the
+[B, H, T, T] score tensor in HBM, the kernel streams K/V blocks through
+VMEM with an online-softmax running (m, l) pair, so HBM traffic is
+O(T·d) and the MXU sees back-to-back [block_q, d]×[d, block_k] matmuls.
+
+Layout: [B, T, H, D] in/out (the model's native layout); the kernel grid
+is (B·H, T/block_q, T/block_k) with K innermost so the (m, l, acc)
+scratch carries across K blocks.  Backward is the standard two-kernel
+flash backward (dKV sweep + dQ sweep) off saved logsumexp rows — the
+reference instead checkpoints 17 intermediate activations
+(`ops/transformer/transformer.py:155-213`).
+
+On non-TPU backends the same kernels run in Pallas interpreter mode so
+CPU CI validates kernel logic bit-for-bit against the XLA reference path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+# 512-wide blocks keep the MXU saturated (swept on v5e: 512/512 is ~1.25x
+# over 128/128 and ~1.2x over the dense XLA path at T=2048); VMEM use at
+# d=128 is ~2.5 MB of the 16 MB budget.
+_DEFAULT_BLOCK = 512
+
+
+def _on_tpu():
+    return jax.default_backend() == "tpu"
+
+
+def dense_attention(q, k, v, mask=None, causal=False, sm_scale=None,
+                    dropout_rate=0.0, dropout_rng=None, deterministic=True):
+    """Dense XLA attention over [B, T, H, D] — the reference path for the
+    flash kernel and the fallback when dropout/masks rule it out.
+    fp32 softmax; `mask` is additive, broadcastable to [B, H, Tq, Tk]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores * sm_scale
+    if causal:
+        t_q, t_k = q.shape[1], k.shape[1]
+        tri = jnp.tril(jnp.ones((t_q, t_k), dtype=bool))
+        scores = jnp.where(tri[None, None, :, :], scores, jnp.float32(-1e30))
+    if mask is not None:
+        scores = scores + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def flash_attention_usable(q, no_dropout: bool,
+                           block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK):
+    """The kernel handles [B, T, H, D] with T divisible by the block size
+    and D a lane-friendly multiple of 64; dropout stays on the XLA path."""
+    if not no_dropout:
+        return False
+    if q.ndim != 4:
+        return False
+    t, d = q.shape[1], q.shape[3]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    return t % block_q == 0 and t % block_k == 0 and d % 64 == 0 and \
+        t >= 128
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal,
+                block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: a K block strictly above the diagonal contributes nothing —
+    # skip its matmuls entirely (the grid still visits it).
+    visible = True
+    if causal:
+        visible = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0]                              # [bq, d] native dtype
+        k = k_ref[0]                              # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+
+        v = v_ref[0]                               # [bk, d]
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [bq, d]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:, :1] = m_new
+        l_scr[:, :1] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+
+
+def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    bh = b * h
+    # [B, T, H, D] -> [B*H, T, D]
+    def to_bht(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
+    qt, kt, vt = to_bht(q), to_bht(k), to_bht(v)
+
+    nq, nk = t // block_q, t // block_k
+    grid = (bh, nq, nk)
+    kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
+                               causal=causal, block_q=block_q,
+                               block_k=block_k)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out, lse
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                    block_q, block_k):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    visible = True
+    if causal:
+        visible = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0]                               # [bq, d] native dtype
+        k = k_ref[0]                               # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]                             # [bq, d]
+        lse = lse_ref[0]                           # [bq, 1]
+        delta = delta_ref[0]                       # [bq, 1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [bq, bk]
+
+        # dV += Pᵀ dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO Vᵀ ; dS = P ⊙ (dP − δ) · scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dK += dSᵀ Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    visible = True
+    if causal:
+        visible = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(visible)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        # dQ += dS K
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    b, t, h, d = q.shape
+    bh = b * h
+
+    def to_bht(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
+
+    def from_bht(x):
+        return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+    qt, kt, vt, dot_ = to_bht(q), to_bht(k), to_bht(v), to_bht(g)
+    ot = to_bht(out)
+    # δ = rowsum(dO ⊙ O) — computed by XLA (one fused elementwise+reduce)
+    delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1, keepdims=True)        # [bh, t, 1]
+
+    nq, nk = t // block_q, t // block_k
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda bhi, qi, ki: (bhi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot_, lse, delta)
+
+    return from_bht(dq), from_bht(dk), from_bht(dv)
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    b, t, h, d = q.shape
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    b, t, h, d = q.shape
+    out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return out_bthd, (q, k, v, out_bthd, lse)
+
+
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=True, sm_scale=None,
+                    block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK,
+                    interpret=None):
+    """Flash attention over [B, T, H, D] tensors; returns [B, T, H, D].
+
+    interpret=None auto-selects Pallas interpreter mode off-TPU so the
+    same kernel code is exercised by CPU tests.
+    """
+    assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
+    t = q.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (
+        f"seq_len {t} must divide by block sizes ({block_q}, {block_k}); "
+        "pad the sequence or pass smaller block_q/block_k")
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not _on_tpu()
+    return _flash(q, k, v, float(sm_scale), bool(causal),
+                  int(block_q), int(block_k), bool(interpret))

@@ -151,7 +151,8 @@ class ThroughputTimer:
             self.end_time = time.time()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration
-            if report_speed and self.global_step_count % self.steps_per_output == 0:
+            if report_speed and \
+                    self.global_step_count % self.steps_per_output < count:
                 self.logging(
                     "{}/{}, SamplesPerSec={}".format(
                         self.epoch_count, self.micro_step_count,
