@@ -1,0 +1,152 @@
+// CPU-Adam: vectorized AdamW on the host, the optimizer half of
+// ZeRO-Offload.
+//
+// TPU-native counterpart of the reference's csrc/adam/cpu_adam.cpp
+// (AVX512/AVX2 intrinsics + OpenMP + tiled async H2D copy-back,
+// ref cpu_adam.cpp:61-66, 675-681). Differences by design:
+//   * plain C ABI (loaded via ctypes) instead of pybind11 — the image
+//     has no pybind11, and a C ABI keeps the Python binding dependency-
+//     free (SURVEY env notes).
+//   * compiler auto-vectorization (-O3 -march=native) + OpenMP instead
+//     of hand-written intrinsics: on modern GCC the fused loop below
+//     vectorizes to the same AVX512 FMA sequence the reference
+//     hand-codes, without freezing the ISA at build time.
+//   * no CUDA-stream copy-back: the engine moves updated params back to
+//     the TPU with a single jax.device_put (XLA pipelines the transfer).
+//
+// Keyed optimizer registry mirrors ref `create_adam`/`adam_update`.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct AdamState {
+    float lr = 1e-3f;
+    float beta1 = 0.9f;
+    float beta2 = 0.999f;
+    float eps = 1e-8f;
+    float weight_decay = 0.0f;
+    bool adamw_mode = true;
+    int64_t step = 0;
+};
+
+std::unordered_map<int, AdamState>& registry() {
+    static std::unordered_map<int, AdamState> r;
+    return r;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ds_adam_create(int optimizer_id, float lr, float beta1, float beta2,
+                   float eps, float weight_decay, int adamw_mode) {
+    AdamState st;
+    st.lr = lr;
+    st.beta1 = beta1;
+    st.beta2 = beta2;
+    st.eps = eps;
+    st.weight_decay = weight_decay;
+    st.adamw_mode = adamw_mode != 0;
+    st.step = 0;
+    registry()[optimizer_id] = st;
+    return 0;
+}
+
+int ds_adam_destroy(int optimizer_id) {
+    registry().erase(optimizer_id);
+    return 0;
+}
+
+// One fused AdamW step over a flat fp32 buffer. Exponential-moment
+// buffers are updated in place; params updated in place.
+// Returns the new step count, or -1 for an unknown optimizer id.
+int64_t ds_adam_step(int optimizer_id, int64_t n, float* params,
+                     const float* grads, float* exp_avg, float* exp_avg_sq,
+                     float lr_override) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    AdamState& st = it->second;
+    st.step += 1;
+
+    const float lr = lr_override > 0.0f ? lr_override : st.lr;
+    const float b1 = st.beta1;
+    const float b2 = st.beta2;
+    const float eps = st.eps;
+    const float wd = st.weight_decay;
+    const bool adamw = st.adamw_mode;
+
+    const float bias1 = 1.0f - std::pow(b1, (float)st.step);
+    const float bias2 = 1.0f - std::pow(b2, (float)st.step);
+    const float step_size = lr / bias1;
+    const float inv_sqrt_bias2 = 1.0f / std::sqrt(bias2);
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        if (!adamw && wd != 0.0f) g += wd * p;  // L2 (classic Adam)
+        float m = b1 * exp_avg[i] + (1.0f - b1) * g;
+        float v = b2 * exp_avg_sq[i] + (1.0f - b2) * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float denom = std::sqrt(v) * inv_sqrt_bias2 + eps;
+        // decoupled decay scales with lr, NOT the bias-corrected step
+        // size (optax.adamw / torch.AdamW semantics)
+        float decay = (adamw && wd != 0.0f) ? lr * wd * p : 0.0f;
+        params[i] = p - step_size * (m / denom) - decay;
+    }
+    return st.step;
+}
+
+// Step + cast updated params to bf16 (uint16 storage) in one pass —
+// the fused fp16-param copy of ref stage2.py:1416-1427 (bf16 on TPU).
+int64_t ds_adam_step_copy_bf16(int optimizer_id, int64_t n, float* params,
+                               const float* grads, float* exp_avg,
+                               float* exp_avg_sq, uint16_t* params_bf16,
+                               float lr_override) {
+    int64_t step = ds_adam_step(optimizer_id, n, params, grads, exp_avg,
+                                exp_avg_sq, lr_override);
+    if (step < 0) return step;
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t bits;
+        std::memcpy(&bits, &params[i], sizeof(bits));
+        // round-to-nearest-even bf16 truncation
+        uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+        params_bf16[i] = (uint16_t)((bits + rounding) >> 16);
+    }
+    return step;
+}
+
+int ds_adam_get_step(int optimizer_id) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    return (int)it->second.step;
+}
+
+// Restore the bias-correction step counter on checkpoint load.
+int ds_adam_set_step(int optimizer_id, int64_t step) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    it->second.step = step;
+    return 0;
+}
+
+int ds_num_threads() {
+#ifdef _OPENMP
+    return omp_get_max_threads();
+#else
+    return 1;
+#endif
+}
+
+}  // extern "C"

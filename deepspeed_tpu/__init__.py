@@ -43,7 +43,9 @@ def initialize(args=None,
     log_dist(f"DeepSpeed-TPU info: version={__version__}", ranks=[0])
 
     from deepspeed_tpu.runtime.pipe.module import PipelineModule
-    if isinstance(model, PipelineModule):
+    is_pipelined_protocol = hasattr(model, "stage_module") and \
+        hasattr(model, "loss_fn")
+    if isinstance(model, PipelineModule) or is_pipelined_protocol:
         from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
         engine = PipelineEngine(args=args,
                                 model=model,

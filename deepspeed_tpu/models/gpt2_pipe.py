@@ -1,0 +1,247 @@
+"""PipelinedGPT2 — GPT-2 partitioned into homogeneous pipeline stages.
+
+The SPMD execution substrate for pipeline parallelism (SURVEY §7 "hard
+parts"): instead of per-stage programs + p2p (ref `runtime/pipe/engine.py`
++ `p2p.py`), stage parameters are STACKED on a leading [S, ...] axis
+sharded over the `pipe` mesh axis, the stage body is `vmap`ed over that
+axis (GSPMD partitions it, so every stage computes concurrently), and the
+activation rotation stage i → i+1 is a `jnp.roll` on the pipe-sharded
+buffer, which XLA lowers to a collective-permute over ICI. A
+`lax.scan` over M + S - 1 ticks realizes the GPipe fill/steady/drain
+timeline; reverse-mode autodiff through the scan + roll generates the
+backward pipeline automatically (the transpose of a collective-permute is
+the reverse permute), replacing the reference's hand-interpreted
+BackwardPass/SendGrad/RecvGrad instruction stream (`schedule.py:182-289`).
+
+Weight tying (ref TiedLayerSpec, `module.py:71-82`): the embedding is used
+by the first-stage embed and the last-stage LM head; both live in the
+replicated (non-pipe-sharded) param group, so the tied-grad allreduce the
+reference runs by hand (`module.py:405-409`) is just gradient addition.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from deepspeed_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
+from deepspeed_tpu.models.gpt2 import (GPT2Config, GPT2Block,
+                                       cross_entropy_loss)
+
+
+class _StageBlocks(nn.Module):
+    """The per-stage body: layers_per_stage sequential GPT2Blocks,
+    scanned so params stack as [layers_per_stage, ...]."""
+    config: GPT2Config
+    layers_per_stage: int
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+
+        class Cell(nn.Module):
+            config: GPT2Config
+
+            @nn.compact
+            def __call__(self, h, det):
+                block_cls = GPT2Block
+                if cfg.remat:
+                    block_cls = nn.remat(block_cls, prevent_cse=False,
+                                         static_argnums=(2,))
+                return block_cls(self.config)(h, det), None
+
+        Scanned = nn.scan(
+            Cell,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(nn.broadcast,),
+            length=self.layers_per_stage,
+            metadata_params={nn.meta.PARTITION_NAME: "layers"},
+        )
+        hidden, _ = Scanned(cfg, name="blocks")(hidden, deterministic)
+        return hidden
+
+
+class PipelinedGPT2:
+    """GPT-2 with parameters grouped for pipelined SPMD execution.
+
+    Param tree: {"embed": {wte, wpe}, "stages": [S, ...]-stacked stage
+    params, "head": {ln_f}}. The engine-facing protocol is
+    `loss_fn(params, batch, rngs, deterministic)` — identical to
+    GPT2ForCausalLM, so the same DeepSpeedEngine step machinery runs it;
+    the pipeline lives *inside* the loss function.
+    """
+
+    def __init__(self, config: GPT2Config, num_stages: int,
+                 num_micro_batches: int):
+        assert config.n_layer % num_stages == 0, \
+            f"n_layer {config.n_layer} must divide stages {num_stages}"
+        self.config = config
+        self.num_stages = num_stages
+        self.num_micro_batches = num_micro_batches
+        self.layers_per_stage = config.n_layer // num_stages
+        self.stage_module = _StageBlocks(config, self.layers_per_stage)
+
+    # -- param init ------------------------------------------------------
+    def init(self, rng, example_batch):
+        cfg = self.config
+        ids = example_batch["input_ids"]
+        mb = ids.shape[0] // self.num_micro_batches
+        t = ids.shape[1]
+        rng_e, rng_s, rng_h = jax.random.split(rng, 3)
+
+        embed = {
+            "wte": jax.random.normal(rng_e, (cfg.vocab_size, cfg.n_embd),
+                                     jnp.float32) * cfg.initializer_range,
+            "wpe": jax.random.normal(rng_h, (cfg.n_positions, cfg.n_embd),
+                                     jnp.float32) * cfg.initializer_range,
+        }
+        x = jnp.zeros((mb, t, cfg.n_embd), cfg.dtype)
+
+        def init_stage(key):
+            return self.stage_module.init(
+                {"params": key, "dropout": key}, x, True)["params"]
+
+        stage_keys = jax.random.split(rng_s, self.num_stages)
+        stages = jax.vmap(init_stage)(stage_keys)     # [S, ...] stacked
+
+        head = {
+            "ln_f": {"scale": jnp.ones((cfg.n_embd,), jnp.float32),
+                     "bias": jnp.zeros((cfg.n_embd,), jnp.float32)},
+        }
+        return {"embed": embed, "stages": stages, "head": head}
+
+    # -- pipeline pieces -------------------------------------------------
+    def _embed(self, embed_params, ids, rng, deterministic):
+        cfg = self.config
+        t = ids.shape[1]
+        h = embed_params["wte"][ids].astype(cfg.dtype) + \
+            embed_params["wpe"][:t][None].astype(cfg.dtype)
+        if not deterministic and cfg.dropout > 0.0:
+            keep = jax.random.bernoulli(rng, 1.0 - cfg.dropout, h.shape)
+            h = jnp.where(keep, h / (1.0 - cfg.dropout), 0.0)
+        return h
+
+    def _stage_apply(self, stage_params, x, rng, deterministic):
+        rngs = {"dropout": rng} if not deterministic else {}
+        return self.stage_module.apply({"params": stage_params}, x,
+                                       deterministic, rngs=rngs)
+
+    def _head_loss(self, head_params, embed_params, hidden, labels):
+        cfg = self.config
+        scale = head_params["ln_f"]["scale"]
+        bias = head_params["ln_f"]["bias"]
+        h32 = hidden.astype(jnp.float32)
+        mu = h32.mean(-1, keepdims=True)
+        var = ((h32 - mu) ** 2).mean(-1, keepdims=True)
+        h32 = (h32 - mu) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
+        h32 = h32 * scale + bias
+        logits = jnp.einsum("btc,vc->btv", h32.astype(cfg.dtype),
+                            embed_params["wte"].astype(cfg.dtype))
+        return cross_entropy_loss(logits, labels)
+
+    # -- the pipelined loss ---------------------------------------------
+    def loss_fn(self, params, batch, rngs=None, deterministic=False,
+                mesh=None, **_):
+        cfg = self.config
+        S = self.num_stages
+        M = self.num_micro_batches
+        rng = (rngs or {}).get("dropout", jax.random.PRNGKey(0))
+
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.concatenate(
+                [ids[:, 1:], jnp.full_like(ids[:, :1], -100)], axis=1)
+        bsz, t = ids.shape
+        assert bsz % M == 0, f"batch {bsz} must divide microbatches {M}"
+        mb = bsz // M
+        micro_ids = ids.reshape(M, mb, t)
+        micro_labels = labels.reshape(M, mb, t)
+
+        def pipe_spec(*rest):
+            if mesh is None:
+                return None
+            return jax.sharding.NamedSharding(
+                mesh, PartitionSpec(PIPE_AXIS, *rest))
+
+        x_buf = jnp.zeros((S, mb, t, cfg.n_embd), cfg.dtype)
+        if mesh is not None:
+            x_buf = jax.lax.with_sharding_constraint(
+                x_buf, pipe_spec(DATA_AXIS))
+
+        vstage = jax.vmap(
+            lambda p, x, r: self._stage_apply(p, x, r, deterministic))
+
+        def tick(carry, tick_idx):
+            x_prev, = carry
+            feed_idx = jnp.clip(tick_idx, 0, M - 1)
+            tokens = jax.lax.dynamic_index_in_dim(
+                micro_ids, feed_idx, 0, keepdims=False)
+            x0 = self._embed(params["embed"], tokens,
+                             jax.random.fold_in(rng, tick_idx),
+                             deterministic)
+            x_in = x_prev.at[0].set(x0)
+            if mesh is not None:
+                x_in = jax.lax.with_sharding_constraint(
+                    x_in, pipe_spec(DATA_AXIS))
+            stage_rngs = jax.vmap(
+                lambda i: jax.random.fold_in(
+                    jax.random.fold_in(rng, tick_idx), i + 1000)
+            )(jnp.arange(S))
+            y = vstage(params["stages"], x_in, stage_rngs)   # [S, mb, t, H]
+            if mesh is not None:
+                y = jax.lax.with_sharding_constraint(y, pipe_spec(DATA_AXIS))
+            out_last = y[-1]
+            # rotate: stage i's output becomes stage i+1's next input;
+            # slot 0 is overwritten by the next tick's embed feed.
+            x_next = jnp.roll(y, 1, axis=0)
+            if mesh is not None:
+                x_next = jax.lax.with_sharding_constraint(
+                    x_next, pipe_spec(DATA_AXIS))
+            return (x_next,), out_last
+
+        num_ticks = M + S - 1
+        (_,), outs = jax.lax.scan(tick, (x_buf,), jnp.arange(num_ticks))
+        # outs: [num_ticks, mb, t, H]; microbatch m exits at tick m + S - 1
+        final = outs[S - 1:]                         # [M, mb, t, H]
+        hidden = final.reshape(M * mb, t, cfg.n_embd)
+        flat_labels = micro_labels.reshape(M * mb, t)
+        return self._head_loss(params["head"], params["embed"],
+                               hidden, flat_labels)
+
+    # -- sharding specs --------------------------------------------------
+    def pipeline_param_specs(self, params):
+        """Base PartitionSpecs: stage-stacked leaves get pipe on dim 0
+        (+ Megatron TP over `model` on the same rules as GPT2);
+        embed/head replicated over pipe."""
+        def stage_leaf_spec(path, leaf):
+            name = "/".join(str(getattr(p, "key", p)) for p in path)
+            nd = np.ndim(leaf)
+            spec = [PIPE_AXIS] + [None] * (nd - 1)
+            if nd >= 2:
+                if "c_attn" in name or "c_fc" in name:
+                    spec[-1] = MODEL_AXIS          # column parallel
+                elif "c_proj" in name and name.endswith("kernel"):
+                    spec[-2] = MODEL_AXIS          # row parallel
+            return PartitionSpec(*spec)
+
+        stages = jax.tree_util.tree_map_with_path(
+            stage_leaf_spec, params["stages"])
+
+        def repl(leaf):
+            return PartitionSpec(*([None] * np.ndim(leaf)))
+
+        return {
+            "embed": jax.tree_util.tree_map(repl, params["embed"]),
+            "stages": stages,
+            "head": jax.tree_util.tree_map(repl, params["head"]),
+        }
+
+    # engine hook (same name as GPT2ForCausalLM's TP spec hook)
+    def tp_param_specs(self, params):
+        return self.pipeline_param_specs(params)

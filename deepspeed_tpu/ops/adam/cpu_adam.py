@@ -1,0 +1,137 @@
+"""DeepSpeedCPUAdam — host-side AdamW over flat numpy buffers.
+
+Counterpart of `deepspeed/ops/adam/cpu_adam.py:12` + `csrc/adam/
+cpu_adam.cpp`. The optimizer half of ZeRO-Offload: fp32 master params
+and both moments live in host RAM; each step consumes device gradients
+and produces updated parameters (optionally cast to bf16 in the same
+native pass, mirroring the fused fp16-param copy of ref
+`stage2.py:1416-1427`).
+
+Falls back to a numpy implementation when the native library is
+unavailable (no g++, or DS_BUILD_CPU_ADAM=0), with identical numerics.
+"""
+
+import itertools
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+_id_counter = itertools.count()
+
+
+def _load_native():
+    try:
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))))
+        from op_builder.cpu_adam import CPUAdamBuilder
+        return CPUAdamBuilder().load()
+    except Exception as e:  # pragma: no cover - depends on toolchain
+        logger.warning(f"cpu_adam native build unavailable ({e}); "
+                       "falling back to numpy")
+        return None
+
+
+class DeepSpeedCPUAdam:
+    """Flat-buffer host AdamW (API shape follows ref cpu_adam.py:12)."""
+
+    def __init__(self, num_elements, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, use_native=True):
+        self.opt_id = next(_id_counter)
+        self.num_elements = int(num_elements)
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+        self.exp_avg = np.zeros(self.num_elements, np.float32)
+        self.exp_avg_sq = np.zeros(self.num_elements, np.float32)
+
+        self._lib = _load_native() if use_native else None
+        if self._lib is not None:
+            self._lib.ds_adam_create(
+                self.opt_id, float(lr), float(betas[0]), float(betas[1]),
+                float(eps), float(weight_decay), int(adamw_mode))
+
+    @property
+    def native(self):
+        return self._lib is not None
+
+    def step(self, params, grads, lr=None, params_bf16_out=None):
+        """In-place AdamW over flat fp32 `params` given fp32 `grads`.
+        If `params_bf16_out` (uint16 view of bf16) is given, the native
+        path also writes the downcast params in the same pass."""
+        import ctypes
+        assert params.dtype == np.float32 and grads.dtype == np.float32
+        assert params.size == self.num_elements == grads.size
+        lr_eff = -1.0 if lr is None else float(lr)
+
+        if self._lib is not None:
+            f32p = ctypes.POINTER(ctypes.c_float)
+            u16p = ctypes.POINTER(ctypes.c_uint16)
+            if params_bf16_out is not None:
+                step = self._lib.ds_adam_step_copy_bf16(
+                    self.opt_id, params.size,
+                    params.ctypes.data_as(f32p),
+                    grads.ctypes.data_as(f32p),
+                    self.exp_avg.ctypes.data_as(f32p),
+                    self.exp_avg_sq.ctypes.data_as(f32p),
+                    params_bf16_out.ctypes.data_as(u16p),
+                    lr_eff)
+            else:
+                step = self._lib.ds_adam_step(
+                    self.opt_id, params.size,
+                    params.ctypes.data_as(f32p),
+                    grads.ctypes.data_as(f32p),
+                    self.exp_avg.ctypes.data_as(f32p),
+                    self.exp_avg_sq.ctypes.data_as(f32p),
+                    lr_eff)
+            self.step_count = int(step)
+            return params
+
+        # numpy fallback (identical math)
+        self.step_count += 1
+        lr_v = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        g = grads
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * params
+        self.exp_avg *= b1
+        self.exp_avg += (1 - b1) * g
+        self.exp_avg_sq *= b2
+        self.exp_avg_sq += (1 - b2) * g * g
+        bias1 = 1 - b1 ** self.step_count
+        bias2 = 1 - b2 ** self.step_count
+        denom = np.sqrt(self.exp_avg_sq) / np.sqrt(bias2) + self.eps
+        update = (lr_v / bias1) * (self.exp_avg / denom)
+        if self.adamw_mode and self.weight_decay:
+            update = update + lr_v * self.weight_decay * params
+        params -= update
+        if params_bf16_out is not None:
+            import jax.numpy as jnp
+            bf = jnp.asarray(params, jnp.bfloat16)
+            params_bf16_out[:] = np.asarray(bf).view(np.uint16)
+        return params
+
+    def state_dict(self):
+        return {"exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq,
+                "step": self.step_count}
+
+    def load_state_dict(self, sd):
+        self.exp_avg[:] = sd["exp_avg"]
+        self.exp_avg_sq[:] = sd["exp_avg_sq"]
+        self.step_count = int(sd["step"])
+        if self._lib is not None:
+            self._lib.ds_adam_set_step(self.opt_id, self.step_count)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_lib", None) is not None:
+                self._lib.ds_adam_destroy(self.opt_id)
+        except Exception:
+            pass

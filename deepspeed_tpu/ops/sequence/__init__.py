@@ -1,0 +1,4 @@
+from deepspeed_tpu.ops.sequence.ring_attention import (
+    ring_attention, ulysses_attention)
+
+__all__ = ["ring_attention", "ulysses_attention"]

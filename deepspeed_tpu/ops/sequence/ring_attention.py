@@ -1,0 +1,187 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference snapshot predates sequence parallelism entirely (SURVEY
+§2.3: its long-sequence story is block-sparse attention + activation
+checkpointing); later DeepSpeed added Ulysses (all-to-all head/sequence
+swap) and the community added ring attention. Both are first-class here
+because they shape the long-context design:
+
+  ring_attention    — Q stays put; KV blocks rotate around the `seq`
+                      mesh axis via `ppermute` (ICI neighbor hops),
+                      merging per-block softmax partials with the
+                      online (m, l) recurrence. HBM per device is
+                      O(T/S · d); total T is unbounded by chip memory.
+  ulysses_attention — `all_to_all` swaps the sequence shard for a head
+                      shard so every device runs *full-sequence*
+                      attention on H/S heads (DeepSpeed-Ulysses
+                      semantics), then swaps back. Cheaper collectives
+                      for moderate T; requires heads % seq_par == 0.
+
+Both run under `shard_map` over the `seq` axis and are transparent to
+autodiff (the transpose of ppermute/all_to_all is the reverse
+ppermute/all_to_all), so the backward pass is itself a ring/all-to-all
+schedule — no hand-written backward communication.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax import shard_map
+
+from deepspeed_tpu.ops.transformer.flash_attention import dense_attention
+
+NEG_INF = -1e30
+
+
+def _block_attn_partial(q, k, v, sm_scale, mask=None):
+    """Unmerged attention partial of one KV block: returns (numerator
+    [B,Tq,H,D], m [B,H,Tq,1], l [B,H,Tq,1]) for online-softmax merging."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)              # [B,H,Tq,1]
+    # fully-masked rows: exp(NEG_INF - NEG_INF) would be 1; clamp m
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)              # [B,H,Tq,1]
+    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return num.astype(jnp.float32), m_safe, l
+
+
+def _merge(acc, num, m_new, l_new):
+    """Merge one block partial into the running (num, m, l)."""
+    num_acc, m_acc, l_acc = acc
+    m = jnp.maximum(m_acc, m_new)
+    a1 = jnp.exp(m_acc - m)          # [B,H,Tq,1]
+    a2 = jnp.exp(m_new - m)
+    # broadcast [B,H,Tq,1] -> [B,Tq,H,1] for the numerator layout
+    def bhq1_to_bqh1(x):
+        return x.transpose(0, 2, 1, 3)
+    num_out = num_acc * bhq1_to_bqh1(a1) + num * bhq1_to_bqh1(a2)
+    l_out = l_acc * a1 + l_new * a2
+    return num_out, m, l_out
+
+
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+    """Per-device body (inside shard_map): local Q [B,Tl,H,D] attends to
+    the full sequence as KV blocks rotate around `axis_name`."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+
+    num0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    m0 = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, tl, 1), jnp.float32)
+
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+
+    def step(carry, step_idx):
+        num, m, l, kb, vb = carry
+        # kv block currently held originated at device (my_idx - step)
+        src = (my_idx - step_idx) % s_size
+        if causal:
+            # chunk-causal: attend iff src < my_idx; diagonal chunk uses
+            # the in-chunk triangular mask
+            rows = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+            tri = rows >= cols
+            full = jnp.ones((tl, tl), bool)
+            none = jnp.zeros((tl, tl), bool)
+            mask2d = jnp.where(src == my_idx, tri,
+                               jnp.where(src < my_idx, full, none))
+            mask = mask2d[None, None, :, :]
+        else:
+            mask = None
+        blk_num, blk_m, blk_l = _block_attn_partial(q, kb, vb, sm_scale,
+                                                    mask)
+        num, m, l = _merge((num, m, l), blk_num, blk_m, blk_l)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (num, m, l, kb, vb), None
+
+    (num, m, l, _, _), _ = jax.lax.scan(
+        step, (num0, m0, l0, k, v), jnp.arange(s_size))
+    l = jnp.maximum(l, 1e-30)
+    out = num / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
+                   sm_scale=None):
+    """Ring attention over [B, T, H, D] with T sharded on `axis_name`."""
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal, sm_scale=sm_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
+                            attn_fn=None):
+    """Per-device body: all-to-all swaps the local sequence shard for a
+    head shard, runs full-sequence attention on H/S heads, swaps back
+    (DeepSpeed-Ulysses dataflow)."""
+    s_size = jax.lax.psum(1, axis_name)
+    b, tl, h, d = q.shape
+    assert h % s_size == 0, \
+        f"heads {h} must divide seq-parallel degree {s_size}"
+
+    def seq_to_head(x):
+        # [B, Tl, H, D] -> [B, Tl*S, H/S, D]: trade head shards for the
+        # full sequence (source devices concatenate in ring order, which
+        # is global sequence order)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def head_to_seq(x):
+        # [B, T, H/S, D] -> [B, Tl, H, D]: the inverse swap
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    if attn_fn is None:
+        attn_fn = functools.partial(dense_attention, causal=causal,
+                                    sm_scale=sm_scale)
+    out = attn_fn(qg, kg, vg)                    # [B, T, H/S, D]
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
+                      sm_scale=None, use_flash=None):
+    """Ulysses sequence-parallel attention over [B, T, H, D] with T
+    sharded on `axis_name`."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        flash_attention, flash_attention_usable)
+
+    attn_fn = None
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
+    if use_flash:
+        def attn_fn(qg, kg, vg):
+            if flash_attention_usable(qg, True):
+                return flash_attention(qg, kg, vg, causal=causal,
+                                       sm_scale=sm_scale)
+            return dense_attention(qg, kg, vg, causal=causal,
+                                   sm_scale=sm_scale)
+
+    spec = PartitionSpec(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ulysses_attention_local, axis_name=axis_name,
+                          causal=causal, sm_scale=sm_scale,
+                          attn_fn=attn_fn),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

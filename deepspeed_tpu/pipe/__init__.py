@@ -1,0 +1,5 @@
+"""Re-export of the pipeline model API (ref `deepspeed/pipe/__init__.py`)."""
+from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
+                                               TiedLayerSpec)
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec"]

@@ -40,6 +40,7 @@ from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
                                         build_mesh, data_sharding, replicated)
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
+from deepspeed_tpu.runtime.zero.offload import ZeroOffloadMixin
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     LossScaleState, make_loss_scale_state, make_static_loss_scale_state,
     update_loss_scale, INITIAL_LOSS_SCALE, SCALE_WINDOW, DELAYED_SHIFT,
@@ -98,7 +99,7 @@ def _fetch_to_host(tree):
     return jax.tree_util.tree_map(one, tree)
 
 
-class DeepSpeedEngine:
+class DeepSpeedEngine(ZeroOffloadMixin):
     """TPU training engine.
 
     Args mirror `deepspeed.initialize` (ref `__init__.py:50`):
@@ -368,6 +369,21 @@ class DeepSpeedEngine:
     def scheduler_params(self):
         return self._config.scheduler_params
 
+    def flops_profiler_enabled(self):
+        return self._config.flops_profiler_config.enabled
+
+    def flops_profiler_profile_step(self):
+        return self._config.flops_profiler_config.profile_step
+
+    def flops_profiler_module_depth(self):
+        return self._config.flops_profiler_config.module_depth
+
+    def flops_profiler_top_modules(self):
+        return self._config.flops_profiler_config.top_modules
+
+    def flops_profiler_detailed(self):
+        return self._config.flops_profiler_config.detailed
+
     def pld_enabled(self):
         return self._config.pld_enabled
 
@@ -495,11 +511,20 @@ class DeepSpeedEngine:
     # state init + sharding
     # ------------------------------------------------------------------
     def _init_state(self):
+        # Copy jax arrays: device_put of an already-placed array aliases
+        # it, and the step donates its input state — without the copy the
+        # caller's (possibly shared) initial params would be invalidated
+        # after the first step.
         params_f32 = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, jnp.float32), self._initial_params)
+            lambda x: jnp.array(x, dtype=jnp.float32, copy=True)
+            if isinstance(x, jax.Array)
+            else jnp.asarray(x, jnp.float32), self._initial_params)
 
         tp_specs = None
-        if self.mp_world_size > 1 and hasattr(self.module, "tp_param_specs"):
+        if hasattr(self.module, "tp_param_specs"):
+            # TP (and, for pipelined models, pipe-stage) placement; a
+            # spec naming a size-1 mesh axis is a no-op, so this is safe
+            # for pure-DP meshes too.
             tp_specs = self.module.tp_param_specs(params_f32)
         self.zero_policy = ZeroShardingPolicy(
             self.mesh, self.zero_optimization_stage(), param_specs=tp_specs)
@@ -508,15 +533,39 @@ class DeepSpeedEngine:
         self._master_shardings = self.zero_policy.master_shardings(params_f32)
         self._acc_shardings = self.zero_policy.grad_accum_shardings(params_f32)
 
-        if self.mixed_precision:
-            master = jax.device_put(params_f32, self._master_shardings)
+        if self.mixed_precision or self._offload_enabled():
             params = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(
                     jnp.asarray(x, self.compute_dtype), s),
                 params_f32, self._param_shardings)
+            # the fp32 master goes to device only when NOT offloading —
+            # offload's whole point is keeping it in host RAM
+            master = None if self._offload_enabled() else \
+                jax.device_put(params_f32, self._master_shardings)
         else:
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
+
+        if self._offload_enabled():
+            # ZeRO-Offload: no device master/opt state; host-side fp32
+            # masters + CPU-Adam moments (runtime/zero/offload.py)
+            self._init_offload(params_f32)
+            self.state = EngineState(
+                params=params, master=None, opt_state=(),
+                scale=make_static_loss_scale_state(
+                    self._host_scaler.cur_scale),
+                acc_grads=jax.device_put(_zeros_like_f32(params_f32),
+                                         self._acc_shardings),
+                skipped=jnp.asarray(0, jnp.int32),
+                global_steps=jnp.asarray(0, jnp.int32))
+            n_params = sum(np.prod(l.shape) for l in
+                           jax.tree_util.tree_leaves(params_f32))
+            log_dist(
+                f"engine initialized (offload): {n_params/1e6:.1f}M params, "
+                f"zero_stage={self.zero_optimization_stage()}, "
+                f"dtype={self.compute_dtype.__name__}, "
+                f"mesh={dict(self.mesh.shape)}", ranks=[0])
+            return
 
         opt_target = master if self.mixed_precision else params
         opt_state = self.optimizer_transform.init(opt_target)
@@ -678,7 +727,28 @@ class DeepSpeedEngine:
 
         self._apply_jit = jax.jit(apply_fn, donate_argnums=(0,))
 
-        gas = self.gradient_accumulation_steps()
+        gas = self._jit_gas()
+
+        if self._offload_enabled():
+            self._build_offload_fns()
+
+            def fused_grads_only(state, stacked_batch, rng, keep_prob):
+                def body(carry, mb):
+                    acc, i = carry
+                    mb_rng = jax.random.fold_in(rng, i)
+                    raw_loss, grads = self._micro_grad(
+                        state.params, mb, mb_rng, state.scale.loss_scale,
+                        keep_prob)
+                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                    return (acc, i + 1), raw_loss
+
+                (acc, _), losses = jax.lax.scan(
+                    body, (state.acc_grads, jnp.asarray(0, jnp.int32)),
+                    stacked_batch, length=gas)
+                return state._replace(acc_grads=acc), jnp.mean(losses)
+
+            self._offload_grads_jit = jax.jit(fused_grads_only,
+                                              donate_argnums=(0,))
 
         def fused_train_step(state, stacked_batch, rng, lr, keep_prob):
             """scan over gas microbatches then update; one compile."""
@@ -740,6 +810,16 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # train API
     # ------------------------------------------------------------------
+    def _jit_gas(self):
+        """Microbatch count the fused jitted step scans over. Pipeline
+        engines fold microbatching inside the loss and override this."""
+        return self.gradient_accumulation_steps()
+
+    def _microbatches_per_step(self):
+        """Microbatches consumed per train_batch call (micro_steps and
+        throughput accounting); pipeline engines override."""
+        return self._jit_gas()
+
     def is_gradient_accumulation_boundary(self):
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
@@ -809,6 +889,11 @@ class DeepSpeedEngine:
 
     def _take_model_step(self, lr_kwargs=None):
         lr = self._next_lr()
+        if self._offload_enabled():
+            overflow = self._offload_take_step(lr)
+            self._host_steps += 1
+            self._after_model_step(jnp.asarray(overflow))
+            return
         self.state, overflow, grad_norm = self._apply_jit(self.state, lr)
         self._host_steps += 1
         self._after_model_step(overflow)
@@ -875,15 +960,54 @@ class DeepSpeedEngine:
         lr = self._next_lr()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)
-        self.state, loss, overflow, grad_norm = self._fused_step_jit(
-            self.state, batch, self._next_rng(), lr, self._keep_prob())
-        self.micro_steps += gas
+        if self.flops_profiler_enabled() and \
+                self._host_steps + 1 == self.flops_profiler_profile_step():
+            self._profile_fused_step(batch, lr)
+        if self._offload_enabled():
+            self.state, loss = self._offload_grads_jit(
+                self.state, batch, self._next_rng(), self._keep_prob())
+            overflow = jnp.asarray(self._offload_take_step(lr))
+            grad_norm = None
+        else:
+            self.state, loss, overflow, grad_norm = self._fused_step_jit(
+                self.state, batch, self._next_rng(), lr, self._keep_prob())
+        mbs = self._microbatches_per_step()
+        self.micro_steps += mbs
         self._host_steps += 1
         self._after_model_step(overflow)
-        # one fused step consumed `gas` microbatches worth of samples
-        self.tput_timer.stop(count=gas)
+        # one fused step consumed `mbs` microbatches worth of samples
+        self.tput_timer.stop(count=mbs)
         self.losses = loss
         return loss
+
+    def _profile_fused_step(self, batch, lr):
+        """One-shot HLO cost-analysis profile of the fused train step
+        (ref engine.py:803-832 drives FlopsProfiler at profile_step)."""
+        from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+        from deepspeed_tpu.profiling.flops_profiler.profiler import num_params
+        prof = FlopsProfiler(self.module)
+        prof.total_params = num_params(self.state.params)
+        prof.start_profile()
+        # fixed key: profiling must not perturb the training RNG stream
+        prof_rng = jax.random.PRNGKey(0)
+        try:
+            if self._offload_enabled():
+                prof.profile_jitted(self._offload_grads_jit, self.state,
+                                    batch, prof_rng,
+                                    self._keep_prob(), measure_time=False)
+            else:
+                prof.profile_jitted(self._fused_step_jit, self.state, batch,
+                                    prof_rng, lr, self._keep_prob(),
+                                    measure_time=False)
+        except Exception as e:  # donated-buffer retrace edge cases
+            logger.warning(f"flops profile failed: {e}")
+            return
+        prof.stop_profile()
+        prof.print_model_profile(
+            profile_step=self.flops_profiler_profile_step(),
+            module_depth=self.flops_profiler_module_depth(),
+            top_modules=self.flops_profiler_top_modules(),
+            detailed=self.flops_profiler_detailed())
 
     def eval_batch(self, batch):
         batch = self._shard_batch(batch)
@@ -919,6 +1043,8 @@ class DeepSpeedEngine:
 
     @property
     def fp32_params(self):
+        if self._offload_enabled():
+            return self._offload_unravel(jnp.asarray(self._host_master))
         return self.state.master if self.mixed_precision else self.state.params
 
     # ------------------------------------------------------------------
@@ -944,6 +1070,9 @@ class DeepSpeedEngine:
             scale=jax.device_get(self.state.scale),
             zero_stage=self.zero_optimization_stage(),
         )
+        if self._offload_enabled():
+            optim_sd["host_adam"] = self._host_adam.state_dict()
+            optim_sd["host_master"] = self._host_master
         save_checkpoint_files(save_dir, tag, sd, optim_sd,
                               zero_enabled=self.zero_optimization())
         if save_latest and jax.process_index() == 0:
@@ -979,7 +1108,16 @@ class DeepSpeedEngine:
 
         opt_state = self.state.opt_state
         scale = self.state.scale
-        if load_optimizer_states and optim_sd is not None:
+        if load_optimizer_states and optim_sd is not None and \
+                self._offload_enabled():
+            if "host_master" in optim_sd:
+                self._host_master[:] = optim_sd["host_master"]
+                self._host_adam.load_state_dict(optim_sd["host_adam"])
+                self._host_scaler.cur_scale = float(
+                    np.asarray(optim_sd["scale"][0]))
+                scale = make_static_loss_scale_state(
+                    self._host_scaler.cur_scale)
+        elif load_optimizer_states and optim_sd is not None:
             opt_state = jax.tree_util.tree_map(
                 lambda cur, saved: jax.device_put(
                     jnp.asarray(saved), cur.sharding),
@@ -1011,3 +1149,4 @@ class DeepSpeedEngine:
         }
         log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
         return f"{load_dir}/{tag}", client_state
+

@@ -1,0 +1,5 @@
+from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
+                                               TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.topology import (
+    ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
+    PipelineParallelGrid)

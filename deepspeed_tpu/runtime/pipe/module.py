@@ -102,6 +102,10 @@ class PipelineModule:
         self.tied_modules = {}
         self.tied_weight_attrs = {}
         self.layers = []
+        # layer idx -> tied key; tied occurrences share ONE param tree in
+        # the params structure (so autodiff sums their gradients — the
+        # SPMD form of the tied-grad allreduce, ref `module.py:405-409`)
+        self.tied_layer_keys = {}
         for idx, spec in enumerate(self._layer_specs):
             if isinstance(spec, TiedLayerSpec):
                 if spec.key not in self.tied_modules:
@@ -111,6 +115,7 @@ class PipelineModule:
                 fn = spec.forward_fn or layer
                 self.layers.append(layer)
                 self.forward_funcs.append(fn)
+                self.tied_layer_keys[idx] = spec.key
             elif isinstance(spec, LayerSpec):
                 layer = spec.build()
                 self.layers.append(layer)
@@ -140,7 +145,8 @@ class PipelineModule:
         return counts
 
     def _partition_layers(self):
-        method = (self.partition_method or "parameters").lower()
+        method_orig = self.partition_method or "parameters"
+        method = method_orig.lower()
         num_layers = len(self._layer_specs)
         if method == "uniform":
             parts = partition_uniform(num_layers, self.num_stages)
@@ -148,7 +154,8 @@ class PipelineModule:
             weights = self._count_layer_params()
             parts = partition_balanced(weights, self.num_stages)
         elif method.startswith("type:"):
-            layertype = method.split(":", 1)[1]
+            # keep original case: the regex matches class names
+            layertype = method_orig.split(":", 1)[1]
             binary_weights = [0] * num_layers
             for idx, layer in enumerate(self.layers):
                 name = type(layer).__name__ if not isinstance(
@@ -180,25 +187,49 @@ class PipelineModule:
 
     # -- functional init/apply (used by the pipeline engine) -------------
     def init_params(self, rng, example_input):
-        """Initialize one param tree per layer: list indexed by layer."""
-        params = []
+        """Initialize the param structure: {"layers": {idx: tree},
+        "tied": {key: tree}}. A tied key appears ONCE no matter how many
+        layers reference it — the weight-sharing contract of
+        TiedLayerSpec (ref `module.py:71-82`)."""
+        layer_params = {}
+        tied_params = {}
         x = example_input
         for idx, layer in enumerate(self.layers):
             rng, sub = jax.random.split(rng)
-            if hasattr(layer, "init"):
+            tied_key = self.tied_layer_keys.get(idx)
+            if tied_key is not None and tied_key in tied_params:
+                p = tied_params[tied_key]
+            elif hasattr(layer, "init"):
                 variables = layer.init({"params": sub, "dropout": sub}, x)
                 p = variables.get("params", variables)
-                params.append(p)
-                x = layer.apply({"params": p}, x)
             else:
-                params.append({})
-                x = layer(x)
-        return params
+                p = {}
+            if tied_key is not None:
+                tied_params[tied_key] = p
+            else:
+                layer_params[str(idx)] = p
+            x = self.apply_layer(idx, p, x)
+        return {"layers": layer_params, "tied": tied_params}
 
-    def apply_layer(self, idx, params, x, rngs=None):
+    def layer_params(self, params, idx):
+        """Fetch layer idx's params from the shared structure (list
+        inputs from older callers still work)."""
+        if isinstance(params, (list, tuple)):
+            return params[idx]
+        tied_key = self.tied_layer_keys.get(idx)
+        if tied_key is not None:
+            return params["tied"][tied_key]
+        return params["layers"][str(idx)]
+
+    def apply_layer(self, idx, params, x, rngs=None, **kwargs):
+        fn = self.forward_funcs[idx]
         layer = self.layers[idx]
+        if fn is not layer and not hasattr(fn, "apply"):
+            # TiedLayerSpec.forward_fn: custom use of the shared params
+            # (e.g. embedding transpose as LM head)
+            return fn(params, x)
         if hasattr(layer, "apply"):
-            return layer.apply({"params": params}, x, rngs=rngs)
+            return layer.apply({"params": params}, x, rngs=rngs, **kwargs)
         return layer(x)
 
 

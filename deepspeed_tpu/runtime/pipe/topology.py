@@ -1,0 +1,245 @@
+"""Process topology: N-D cartesian rank <-> coordinate mapping.
+
+Parity with `deepspeed/runtime/pipe/topology.py:12-455`. The rank math is
+backend-agnostic and ports directly; what changes on TPU is what the
+topology *produces*: instead of building NCCL process groups per axis
+(`topology.py:299-364`), `PipelineParallelGrid` wraps a
+`jax.sharding.Mesh` — each named axis IS the communicator, and XLA lowers
+collectives onto ICI. The grid still implements the Megatron-style `mpu`
+interface (`get_model_parallel_rank` etc., ref `topology.py:365-455`)
+so user code written against an mpu keeps working.
+"""
+
+from collections import namedtuple
+from itertools import product
+
+
+class ProcessTopology:
+    """Cartesian product topology over named axes (ref `topology.py:12`).
+
+    axes: list of axis names, ordered major (outer) to minor (inner).
+    dims: per-axis sizes, same order.
+    """
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, "
+                             f"got {list(coord_kwargs)}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"),
+                      inner_sep="_", outer_sep="-"):
+        """String like 'model_00' naming a rank's non-omitted coords
+        (used for checkpoint filenames, ref `topology.py:54-81`)."""
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, r in self.mapping.items():
+            if r == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that vary only along `axis` (the reference
+        builds one process group per list, ref `topology.py:130-166`)."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for other_coord in product(*ranges):
+            fixed = dict(zip(other_axes, other_coord))
+            ranks = [self.get_rank(**{axis: i, **fixed})
+                     for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        """Ranks whose coords match all kwargs (ref `topology.py:168-190`)."""
+        def _filter_helper(x):
+            for key, val in filter_kwargs.items():
+                if getattr(x, key) != val:
+                    return False
+            return True
+        coords = filter(_filter_helper, self.mapping.keys())
+        return [self.mapping[coord] for coord in coords]
+
+    def get_axis_list(self, axis, idx):
+        """Ranks with coord[axis] == idx, sorted (ref `topology.py:192`)."""
+        axis_num = self.axes.index(axis)
+        ranks = [self.mapping[k] for k in self.mapping.keys()
+                 if k[axis_num] == idx]
+        return sorted(ranks)
+
+    def world_size(self):
+        size = 1
+        for d in self.dims:
+            size *= d
+        return size
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+def _prime_factors(N):
+    """Prime factorization, ascending (ref `topology.py:228`)."""
+    if N <= 0:
+        raise ValueError("Factorize only positive integers")
+    primes = []
+    while N != 1:
+        for candidate in range(2, N + 1):
+            if N % candidate == 0:
+                primes.append(candidate)
+                N //= candidate
+                break
+    return primes
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """Hybrid pipeline+data topology; adjacent pipe stages land on
+    neighboring device-mesh coordinates (ref `topology.py:235-244`)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D topology: pipeline / model (tensor) / data
+    (ref `topology.py:246-249`)."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Megatron-compatible `mpu` facade over a topology / jax Mesh
+    (ref `topology.py:252-455`).
+
+    On TPU there are no process groups to construct: the mesh axes are
+    the communicators. This class supplies rank arithmetic for
+    checkpoint naming, data sharding, and mpu-consuming user code.
+    `global_rank` is `jax.process_index()`-based when running
+    multi-controller, else 0 (single-controller SPMD drives all devices).
+    """
+
+    def __init__(self, topology=None, process_group=None, mesh=None,
+                 global_rank=0):
+        if topology is None:
+            assert mesh is not None, "need a topology or a mesh"
+            shape = dict(mesh.shape)
+            topology = PipeModelDataParallelTopology(
+                num_pp=shape.get("pipe", 1), num_mp=shape.get("model", 1),
+                num_dp=shape.get("data", 1))
+        self._topo = topology
+        self.mesh = mesh
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+
+        self.data_parallel_size = max(topology.get_dim("data"), 1)
+        self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
+        self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.slice_parallel_size = self.model_parallel_size
+        assert self._is_grid_valid(), "Invalid Grid"
+
+        self.stage_id = self.get_stage_id()
+        self.data_parallel_id = self.get_data_parallel_id()
+
+        # Rank lists per pipeline stage (parity with `self.p2p_groups` /
+        # stage_to_global bookkeeping, ref `topology.py:287-330`).
+        self.pp_group = []
+        self.dp_group = []
+        for dp in range(self.data_parallel_size):
+            ranks = sorted(self._topo.filter_match(data=dp)) \
+                if "data" in self._topo.get_axis_names() else []
+            self.pp_group.append(ranks)
+        for stage in range(self.pipe_parallel_size):
+            if "pipe" in self._topo.get_axis_names():
+                self.dp_group.append(
+                    sorted(self._topo.filter_match(pipe=stage)))
+
+    def _is_grid_valid(self):
+        ranks = 1
+        for ax in self._topo.get_axis_names():
+            ranks *= self._topo.get_dim(ax)
+        return ranks == self.world_size
+
+    # -- stage / pipe ----------------------------------------------------
+    def get_stage_id(self):
+        if "pipe" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "pipe")
+
+    def get_pipe_parallel_rank(self):
+        return self.get_stage_id()
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def stage_to_global(self, stage_id, **kwargs):
+        me = self._topo.get_coord(self.global_rank)
+        transform = me._replace(pipe=stage_id, **kwargs)._asdict()
+        return self._topo.get_rank(**transform)
+
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self.pipe_parallel_size - 1
+
+    # -- data parallel ---------------------------------------------------
+    def get_data_parallel_id(self):
+        if "data" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "data")
+
+    def get_data_parallel_rank(self):
+        return self.get_data_parallel_id()
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    # -- model (tensor) parallel ----------------------------------------
+    def get_model_parallel_rank(self):
+        if "model" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank), "model")
+
+    get_slice_parallel_rank = get_model_parallel_rank
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    get_slice_parallel_world_size = get_model_parallel_world_size
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    def get_topology(self):
+        return self._topo

@@ -1,0 +1,136 @@
+"""ZeRO-Offload integration (host master params + native CPU-Adam).
+
+See csrc/adam/cpu_adam.cpp and ops/adam/cpu_adam.py for the native step.
+Counterpart of ref `stage2.py:743-941,1416-1427`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    make_static_loss_scale_state)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _zeros_like_f32(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), tree)
+
+
+class ZeroOffloadMixin:
+    """ZeRO-Offload: fp32 master params + Adam moments live in host RAM,
+    stepped by the native CPU-Adam (`csrc/adam/cpu_adam.cpp`); the device
+    holds only compute-dtype params and the fp32 grad accumulator.
+
+    Counterpart of ref `stage2.py:743-941,1416-1427` (pinned-buffer grad
+    offload + CPUAdam step + fused fp16 param copy-back): here the jitted
+    step produces one flat fp32 grad vector, the host applies AdamW and
+    downcasts to bf16 in the same native pass, and a single device_put
+    returns the updated params — XLA pipelines the transfers that the
+    reference overlaps with CUDA streams.
+    """
+
+    def _offload_enabled(self):
+        return bool(self.zero_optimization() and self.zero_cpu_offload())
+
+    def _init_offload(self, params_f32):
+        from jax.flatten_util import ravel_pytree
+        from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+        from deepspeed_tpu.runtime.fp16.loss_scaler import CreateLossScaler
+
+        flat, self._offload_unravel = ravel_pytree(params_f32)
+        self._host_master = np.asarray(jax.device_get(flat),
+                                       dtype=np.float32).copy()
+        p = dict(self._config.optimizer_params or {})
+        betas = p.get("betas", (0.9, 0.999))
+        self._host_adam = DeepSpeedCPUAdam(
+            flat.size,
+            lr=p.get("lr", 1e-3),
+            betas=betas,
+            eps=p.get("eps", 1e-8),
+            weight_decay=p.get("weight_decay", 0.0),
+            adamw_mode=p.get("adam_w_mode", True) or
+            (self._config.optimizer_name or "").lower() == C.ADAMW_OPTIMIZER)
+        self._host_scaler = CreateLossScaler(
+            dtype_fp16=self.fp16_mode,
+            static_loss_scale=self._config.loss_scale,
+            dynamic_scaling=self.dynamic_loss_scale_enabled,
+            dynamic_loss_args=self.dynamic_loss_scale_args())
+        log_dist(
+            f"ZeRO-Offload: {flat.size/1e6:.1f}M fp32 masters + moments "
+            f"on host (native cpu_adam={self._host_adam.native})", ranks=[0])
+
+    def _build_offload_fns(self):
+        """Jitted halves of the offload step."""
+        clip = self.gradient_clipping()
+
+        def grad_tail(acc_grads, loss_scale):
+            from jax.flatten_util import ravel_pytree
+            flat, _ = ravel_pytree(acc_grads)
+            flat = flat / loss_scale
+            norm = jnp.sqrt(jnp.vdot(flat, flat))
+            if clip and clip > 0:
+                factor = jnp.minimum(1.0, clip / (norm + 1e-6))
+                factor = jnp.where(jnp.isfinite(factor), factor, 1.0)
+                flat = flat * factor
+            return flat, norm
+
+        self._offload_grad_tail_jit = jax.jit(grad_tail)
+
+        def rebuild_params(flat):
+            # flat (compute dtype or fp32) -> param tree with shardings
+            tree = self._offload_unravel(flat.astype(jnp.float32))
+            tree = jax.tree_util.tree_map(
+                lambda x: x.astype(self.compute_dtype), tree)
+            return jax.lax.with_sharding_constraint(
+                tree, self._param_pspecs_cached)
+
+        self._offload_rebuild_jit = jax.jit(rebuild_params)
+
+    def _zero_acc(self):
+        """Fresh grad accumulator with the engine's shardings (a plain
+        jnp.zeros would change input shardings and force a recompile)."""
+        return jax.device_put(_zeros_like_f32(self.state.acc_grads),
+                              self._acc_shardings)
+
+    def _offload_take_step(self, lr):
+        """Host half: fetch clipped grads, CPU-Adam, push params."""
+        flat, norm = self._offload_grad_tail_jit(
+            self.state.acc_grads, self.state.scale.loss_scale)
+        norm_host = float(jax.device_get(norm))
+        overflow = not np.isfinite(norm_host)
+        self._host_scaler.update_scale(overflow)
+        new_scale = make_static_loss_scale_state(
+            self._host_scaler.cur_scale) if self.fp16_mode else \
+            self.state.scale
+
+        if overflow:
+            self.state = self.state._replace(
+                scale=new_scale,
+                acc_grads=self._zero_acc(),
+                skipped=self.state.skipped + 1)
+            return True
+
+        grads_np = np.asarray(jax.device_get(flat), dtype=np.float32)
+        if self.compute_dtype == jnp.bfloat16:
+            # fused native step + bf16 downcast in one pass
+            bf16_out = np.empty(grads_np.size, np.uint16)
+            self._host_adam.step(self._host_master, grads_np,
+                                 lr=lr if lr is not None else None,
+                                 params_bf16_out=bf16_out)
+            flat_dev = jnp.asarray(bf16_out).view(jnp.bfloat16)
+        else:
+            # fp16/fp32 compute: push fp32 masters, cast on device (a
+            # bf16 round-trip would truncate fp16's 11-bit mantissa)
+            self._host_adam.step(self._host_master, grads_np,
+                                 lr=lr if lr is not None else None)
+            flat_dev = jnp.asarray(self._host_master)
+        new_params = self._offload_rebuild_jit(flat_dev)
+        self.state = self.state._replace(
+            params=new_params,
+            scale=new_scale,
+            acc_grads=self._zero_acc(),
+            global_steps=self.state.global_steps + 1)
+        return False

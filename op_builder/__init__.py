@@ -1,0 +1,13 @@
+from op_builder.builder import OpBuilder, get_default_compute_capabilities
+from op_builder.cpu_adam import CPUAdamBuilder
+
+# Registry of all native ops (ref `op_builder/__init__.py:11-21`). The
+# CUDA builders of the reference (fused_adam/lamb/transformer/
+# sparse_attn) have no native artifact here: their roles are filled by
+# XLA/Pallas kernels compiled at trace time, which ds_report reports.
+ALL_OPS = {
+    "cpu_adam": CPUAdamBuilder,
+}
+
+__all__ = ["OpBuilder", "CPUAdamBuilder", "ALL_OPS",
+           "get_default_compute_capabilities"]

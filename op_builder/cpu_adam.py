@@ -1,0 +1,37 @@
+"""CPU-Adam builder (ref `op_builder/cpu_adam.py`)."""
+
+import ctypes
+import os
+
+from op_builder.builder import OpBuilder, REPO_ROOT
+
+
+class CPUAdamBuilder(OpBuilder):
+    BUILD_VAR = "DS_BUILD_CPU_ADAM"
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return [os.path.join(REPO_ROOT, "csrc", "adam", "cpu_adam.cpp")]
+
+    def _declare(self, lib):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adam_create.argtypes = [
+            ctypes.c_int, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_float, ctypes.c_int]
+        lib.ds_adam_create.restype = ctypes.c_int
+        lib.ds_adam_destroy.argtypes = [ctypes.c_int]
+        lib.ds_adam_destroy.restype = ctypes.c_int
+        lib.ds_adam_step.argtypes = [
+            ctypes.c_int, ctypes.c_int64, f32p, f32p, f32p, f32p,
+            ctypes.c_float]
+        lib.ds_adam_step.restype = ctypes.c_int64
+        lib.ds_adam_step_copy_bf16.argtypes = [
+            ctypes.c_int, ctypes.c_int64, f32p, f32p, f32p, f32p, u16p,
+            ctypes.c_float]
+        lib.ds_adam_step_copy_bf16.restype = ctypes.c_int64
+        lib.ds_adam_get_step.argtypes = [ctypes.c_int]
+        lib.ds_adam_get_step.restype = ctypes.c_int
+        lib.ds_adam_set_step.argtypes = [ctypes.c_int, ctypes.c_int64]
+        lib.ds_adam_set_step.restype = ctypes.c_int
+        lib.ds_num_threads.restype = ctypes.c_int
