@@ -77,7 +77,8 @@ int64_t ds_adam_step(int optimizer_id, int64_t n, float* params,
     AdamState& st = it->second;
     st.step += 1;
 
-    const float lr = lr_override > 0.0f ? lr_override : st.lr;
+    // negative = no override; 0.0 is a legitimate scheduled lr
+    const float lr = lr_override >= 0.0f ? lr_override : st.lr;
     const float b1 = st.beta1;
     const float b2 = st.beta2;
     const float eps = st.eps;

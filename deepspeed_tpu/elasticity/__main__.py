@@ -1,0 +1,39 @@
+"""`ds_elastic` CLI (ref `bin/ds_elastic`): inspect elastic config —
+given a ds_config JSON, print the final batch size, valid device counts,
+and micro-batch per device-count breakdown.
+
+Run as `python -m deepspeed_tpu.elasticity -c ds_config.json [-w N]`."""
+
+import argparse
+import json
+
+from deepspeed_tpu.elasticity import compute_elastic_config
+from deepspeed_tpu.version import __version__
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-c", "--config", required=True,
+                        help="DeepSpeed config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="Intended/current world size")
+    args = parser.parse_args()
+
+    with open(args.config) as fd:
+        ds_config = json.load(fd)
+
+    if args.world_size > 0:
+        final_batch_size, valid_gpus, micro_batch_size = \
+            compute_elastic_config(ds_config=ds_config,
+                                   target_deepspeed_version=__version__,
+                                   world_size=args.world_size)
+        print(f"micro_batch_size .... {micro_batch_size}")
+    else:
+        final_batch_size, valid_gpus = compute_elastic_config(
+            ds_config=ds_config, target_deepspeed_version=__version__)
+    print(f"final_batch_size .... {final_batch_size}")
+    print(f"valid_gpus .......... {valid_gpus}")
+
+
+if __name__ == "__main__":
+    main()

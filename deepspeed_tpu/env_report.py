@@ -1,0 +1,92 @@
+"""`ds_report` — environment and native-op compatibility report.
+
+Counterpart of `deepspeed/env_report.py:23-105`: per-op
+compatible/installed matrix (our ops are the C++ builders in op_builder/
+plus the trace-time Pallas kernels), framework versions, and device
+inventory. Run as `python -m deepspeed_tpu.env_report`."""
+
+import os
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f"{RED}[FAIL]{END}"
+INFO = "[INFO]"
+
+COLUMNS = ["op name", "installed", "compatible"]
+
+
+def op_report():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from op_builder import ALL_OPS
+
+    max_dots = 23
+    print("-" * 64)
+    print("DeepSpeed-TPU C++ op report")
+    print("-" * 64)
+    print("native ops compile with g++ on first use (JIT), cached by "
+          "source hash")
+    print("-" * 64)
+    print("op name", "." * max_dots, "installed", "..", "compatible")
+    print("-" * 64)
+    for name, builder_cls in ALL_OPS.items():
+        builder = builder_cls()
+        installed = SUCCESS if builder.installed() else "[NO]"
+        compatible = SUCCESS if builder.is_compatible() else FAIL
+        dots = "." * (max_dots - len(name))
+        print(name, dots, installed, "..", compatible)
+    print("-" * 64)
+    print("trace-time kernels (no prebuild needed):")
+    print("  flash_attention ......... Pallas (TPU) / interpret (CPU)")
+    print("  block_sparse_attention .. Pallas masked-flash")
+    print("  fused train step ........ XLA fusion of loss/grad/update")
+    print("-" * 64)
+
+
+def debug_report():
+    import jax
+    import jaxlib
+
+    report = [("jax version", jax.__version__),
+              ("jaxlib version", jaxlib.__version__)]
+    try:
+        import flax
+        report.append(("flax version", flax.__version__))
+    except ImportError:
+        pass
+    try:
+        import optax
+        report.append(("optax version", optax.__version__))
+    except ImportError:
+        pass
+    try:
+        devices = jax.devices()
+        report.append(("backend", jax.default_backend()))
+        report.append(("device count", len(devices)))
+        report.append(("device kind", devices[0].device_kind))
+    except Exception as e:
+        report.append(("devices", f"unavailable: {e}"))
+    import deepspeed_tpu
+    report.append(("deepspeed_tpu version", deepspeed_tpu.__version__))
+    report.append(("deepspeed_tpu install path",
+                   os.path.dirname(deepspeed_tpu.__file__)))
+
+    print("DeepSpeed-TPU general environment info:")
+    for name, value in report:
+        print(f"{name} {'.' * (28 - len(name))} {value}")
+
+
+def main():
+    op_report()
+    debug_report()
+
+
+cli_main = main
+
+if __name__ == "__main__":
+    main()

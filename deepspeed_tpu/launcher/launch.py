@@ -1,0 +1,77 @@
+"""Per-node launcher: starts the host's JAX controller process.
+
+Counterpart of `deepspeed/launcher/launch.py:67` (171 LoC). The reference
+spawns one process per local GPU with RANK/LOCAL_RANK/CUDA_VISIBLE_DEVICES;
+a TPU host runs ONE controller that drives all local chips, so this
+launcher execs a single child with the `jax.distributed` rendezvous env
+(COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID) plus the reference's
+env names (RANK, LOCAL_RANK, WORLD_SIZE, MASTER_ADDR/PORT) for user code
+that reads them. Children are killed as a group on failure/signal
+(ref `launch.py:128-167`)."""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", default="None", type=str)
+    parser.add_argument("--node_rank", default=-1, type=int)
+    parser.add_argument("--master_addr", default="127.0.0.1", type=str)
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("training_script", type=str)
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def main(args=None):
+    args = parse_args(args)
+    assert args.world_info != "None", "world_info is required"
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    num_nodes = len(hosts)
+    node_rank = args.node_rank
+    if node_rank < 0:
+        import socket
+        hostname = socket.gethostname()
+        node_rank = hosts.index(hostname) if hostname in hosts else 0
+    assert 0 <= node_rank < num_nodes, \
+        f"node_rank {node_rank} out of range for {num_nodes} nodes"
+
+    env = os.environ.copy()
+    # jax.distributed rendezvous (the NCCL-handshake replacement)
+    env["COORDINATOR_ADDRESS"] = f"{args.master_addr}:{args.master_port}"
+    env["NUM_PROCESSES"] = str(num_nodes)
+    env["PROCESS_ID"] = str(node_rank)
+    # reference-compatible names for user code
+    env["MASTER_ADDR"] = args.master_addr
+    env["MASTER_PORT"] = str(args.master_port)
+    env["WORLD_SIZE"] = str(num_nodes)
+    env["RANK"] = str(node_rank)
+    env["LOCAL_RANK"] = "0"
+    env["DS_NODE_RANK"] = str(node_rank)
+
+    cmd = [sys.executable, "-u", args.training_script] + \
+        args.training_script_args
+    logger.info(f"node {node_rank}: {' '.join(cmd)}")
+    process = subprocess.Popen(cmd, env=env)
+
+    def sig_handler(signum, frame):
+        process.terminate()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+    process.wait()
+    if process.returncode != 0:
+        sys.exit(process.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,211 @@
+"""`dstpu` launcher CLI — multi-host job fan-out.
+
+Counterpart of `deepspeed/launcher/runner.py:254` (364 LoC). The hostfile
+grammar (`worker-0 slots=4`), `--include/--exclude` filters, and base64
+world-info encoding are preserved verbatim — they're backend-agnostic.
+What changes: a "slot" is a TPU *host* process (one JAX controller per
+host drives all its local chips), the rendezvous is
+`jax.distributed.initialize` via COORDINATOR_ADDRESS instead of NCCL's
+MASTER_ADDR handshake, and a pod-native runner resolves TPU topology
+from the environment when no hostfile is given.
+"""
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+from collections import OrderedDict
+from shlex import split
+
+from deepspeed_tpu.launcher.multinode_runner import (PDSHRunner,
+                                                     OpenMPIRunner,
+                                                     MVAPICHRunner)
+from deepspeed_tpu.utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY_PATH", "TPU", "JAX", "XLA",
+               "LIBTPU"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+DEEPSPEED_ENVIRONMENT_PATHS = [".", os.path.expanduser("~")]
+PDSH_MAX_FAN_OUT = 1024
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="DeepSpeed-TPU distributed launcher")
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="Hostfile path: lines of `hostname slots=N`")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help='Include spec "host1@host2:0,2"')
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help='Exclude spec "host1:0@host2"')
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_hosts_slots", type=int,
+                        default=-1, dest="num_gpus")
+    parser.add_argument("--master_port", default=29500, type=int)
+    parser.add_argument("--master_addr", default="", type=str)
+    parser.add_argument("--launcher", default="pdsh", type=str,
+                        help="pdsh | openmpi | mvapich")
+    parser.add_argument("--launcher_args", default="", type=str)
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse `hostname slots=N` lines (ref `runner.py:115-143`)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool = OrderedDict()
+    with open(hostfile_path, "r") as fd:
+        for line in fd.readlines():
+            line = line.strip()
+            if line == "" or line.startswith("#"):
+                continue
+            try:
+                hostname, slots = line.split()
+                _, slot_count = slots.split("=")
+                slot_count = int(slot_count)
+            except ValueError as err:
+                logger.error(f"Hostfile is not formatted correctly: {line}")
+                raise err
+            if hostname in resource_pool:
+                logger.error(f"Hostfile contains duplicate hosts: {line}")
+                raise ValueError(f"host {hostname} is already defined")
+            resource_pool[hostname] = slot_count
+    return resource_pool
+
+
+def _parse_hosts_string(string):
+    """'worker-0:0,2@worker-1' -> {host: [slots] or []}"""
+    result = {}
+    if not string:
+        return result
+    for node_config in string.split("@"):
+        if ":" in node_config:
+            hostname, slots = node_config.split(":")
+            result[hostname] = [int(x) for x in slots.split(",")]
+        else:
+            result[node_config] = []
+    return result
+
+
+def parse_inclusion_exclusion(resource_pool, inclusion, exclusion):
+    """Filter the resource pool (ref `runner.py:146-235`). Returns
+    {host: [slot indices]}."""
+    active_resources = OrderedDict(
+        (host, list(range(slots))) for host, slots in resource_pool.items())
+    include = _parse_hosts_string(inclusion)
+    exclude = _parse_hosts_string(exclusion)
+    if include and exclude:
+        raise ValueError("include and exclude are mutually exclusive")
+
+    for hostname in list(include) + list(exclude):
+        if hostname not in resource_pool:
+            raise ValueError(f"Hostname '{hostname}' not found in hostfile")
+
+    if include:
+        filtered = OrderedDict()
+        for host, slots in include.items():
+            available = active_resources[host]
+            chosen = slots if slots else available
+            for s in chosen:
+                if s not in available:
+                    raise ValueError(
+                        f"No slot '{s}' specified on host '{host}'")
+            filtered[host] = sorted(chosen)
+        return filtered
+
+    for host, slots in exclude.items():
+        if slots:
+            for s in slots:
+                if s not in active_resources[host]:
+                    raise ValueError(
+                        f"No slot '{s}' specified on host '{host}'")
+                active_resources[host].remove(s)
+            if not active_resources[host]:
+                del active_resources[host]
+        else:
+            del active_resources[host]
+    return active_resources
+
+
+def encode_world_info(world_info):
+    json_str = json.dumps(world_info)
+    return base64.urlsafe_b64encode(json_str.encode()).decode()
+
+
+def decode_world_info(encoded):
+    return json.loads(base64.urlsafe_b64decode(encoded.encode()).decode())
+
+
+def main(args=None):
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    if resource_pool is None:
+        # single node: run the user script under one local controller
+        # (jax discovers all local TPU chips itself)
+        env = os.environ.copy()
+        if args.num_nodes > 1:
+            raise ValueError("num_nodes>1 requires a hostfile")
+        cmd = [sys.executable, "-u", args.user_script] + args.user_args
+        logger.info(f"cmd = {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        if result.returncode != 0:
+            sys.exit(result.returncode)
+        return
+
+    active_resources = parse_inclusion_exclusion(resource_pool,
+                                                 args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = list(active_resources.keys())[:args.num_nodes]
+        active_resources = OrderedDict(
+            (h, active_resources[h]) for h in active)
+    if args.num_gpus > 0:
+        active_resources = OrderedDict(
+            (h, s[:args.num_gpus]) for h, s in active_resources.items())
+
+    world_info = encode_world_info(
+        {h: s for h, s in active_resources.items()})
+    master_addr = args.master_addr or list(active_resources.keys())[0]
+
+    runner_cls = {"pdsh": PDSHRunner, "openmpi": OpenMPIRunner,
+                  "mvapich": MVAPICHRunner}.get(args.launcher.lower())
+    if runner_cls is None:
+        raise NotImplementedError(f"Unknown launcher {args.launcher}")
+    runner = runner_cls(args, world_info)
+    if not runner.backend_exists():
+        raise RuntimeError(
+            f"launcher '{args.launcher}' not installed on this host")
+
+    # .deepspeed_env propagation (ref runner.py:27,343-354)
+    exports = {}
+    for var, val in os.environ.items():
+        if any(var.startswith(name) for name in EXPORT_ENVS):
+            exports[var] = val
+    for path in DEEPSPEED_ENVIRONMENT_PATHS:
+        env_file = os.path.join(path, DEEPSPEED_ENVIRONMENT_NAME)
+        if os.path.isfile(env_file):
+            with open(env_file) as fd:
+                for line in fd.readlines():
+                    line = line.strip()
+                    if not line or line.startswith("#") or "=" not in line:
+                        continue
+                    key, val = line.split("=", 1)
+                    exports[key] = val
+
+    cmd = runner.get_cmd(exports, active_resources, master_addr)
+    logger.info(f"cmd = {' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=os.environ.copy())
+    result.wait()
+    if result.returncode != 0:
+        sys.exit(result.returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -31,9 +31,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from jax import shard_map
 
-from deepspeed_tpu.ops.transformer.flash_attention import dense_attention
-
-NEG_INF = -1e30
+from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF,
+                                                           dense_attention)
 
 
 def _block_attn_partial(q, k, v, sm_scale, mask=None):

@@ -1,0 +1,18 @@
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, DenseSparsityConfig, FixedSparsityConfig,
+    VariableSparsityConfig, BigBirdSparsityConfig,
+    BSLongformerSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+    block_sparse_attention, layout_to_dense_mask)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
+    SparseSelfAttention, BertSparseSelfAttention)
+from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
+    SparseAttentionUtils)
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "block_sparse_attention",
+    "layout_to_dense_mask", "SparseSelfAttention",
+    "BertSparseSelfAttention", "SparseAttentionUtils",
+]

@@ -1,0 +1,63 @@
+"""Model-surgery helpers for sparse attention
+(ref `sparse_attention_utils.py:13-225`): pad sequences to a block
+multiple, extend position embeddings for longer contexts."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseAttentionUtils:
+    @staticmethod
+    def extend_position_embedding(pos_embedding, max_position):
+        """Tile an existing [old_max, H] position embedding out to
+        max_position rows (ref `:34-76` repeats the learned table)."""
+        old_max, hidden = np.asarray(pos_embedding).shape
+        assert max_position > old_max, \
+            "new max_position must exceed the original"
+        reps = int(np.ceil(max_position / old_max))
+        extended = np.tile(np.asarray(pos_embedding), (reps, 1))
+        return jnp.asarray(extended[:max_position])
+
+    @staticmethod
+    def pad_to_block_size(block_size, input_ids=None, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id=0,
+                          model_embeddings=None):
+        """Right-pad sequence tensors to a multiple of block_size
+        (ref `:156-225`). Returns (pad_len, *padded tensors in the same
+        order)."""
+        ref = input_ids if input_ids is not None else inputs_embeds
+        seq_len = ref.shape[1]
+        pad_len = (block_size - seq_len % block_size) % block_size
+
+        def pad_tokens(x, value=0):
+            if x is None or pad_len == 0:
+                return x
+            widths = [(0, 0), (0, pad_len)] + \
+                [(0, 0)] * (np.ndim(x) - 2)
+            return jnp.pad(jnp.asarray(x), widths, constant_values=value)
+
+        input_ids = pad_tokens(input_ids, pad_token_id)
+        attention_mask = pad_tokens(attention_mask, 0)
+        token_type_ids = pad_tokens(token_type_ids, 0)
+        position_ids = pad_tokens(position_ids, 0)
+        if inputs_embeds is not None and pad_len > 0:
+            if model_embeddings is not None:
+                pad_ids = jnp.full((inputs_embeds.shape[0], pad_len),
+                                   pad_token_id, jnp.int32)
+                pad_embeds = model_embeddings[pad_ids]
+            else:
+                pad_embeds = jnp.zeros(
+                    (inputs_embeds.shape[0], pad_len,
+                     inputs_embeds.shape[2]), inputs_embeds.dtype)
+            inputs_embeds = jnp.concatenate([inputs_embeds, pad_embeds],
+                                            axis=1)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len, sequence_output):
+        """Drop the padding rows added by pad_to_block_size (ref `:227`)."""
+        if pad_len > 0:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

@@ -1,0 +1,131 @@
+"""SparseSelfAttention + BertSparseSelfAttention modules.
+
+Parity with `deepspeed/ops/sparse_attention/sparse_self_attention.py:14-164`
+and `bert_sparse_self_attention.py:9`. The reference assembles QKᵀ (sdd)
+→ scaled masked softmax → ·V (dsd) from Triton block ops with a
+per-seq-len layout cache; here the whole chain is one layout-gated
+Pallas flash kernel (`block_sparse_attention.py`), with the same
+layout-cache keyed on sequence length.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
+    SparsityConfig, FixedSparsityConfig)
+from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+    block_sparse_attention, block_sparse_attention_dense_fallback, NEG_INF,
+    layout_to_dense_mask)
+
+
+class SparseSelfAttention:
+    """Applies block-sparse scaled-dot-product attention
+    (ref `sparse_self_attention.py:14`).
+
+    Call with q, k, v of shape [B, T, H, D] (the reference uses
+    [B, H, T, D]; BTHD is this framework's native layout).
+    """
+
+    # layout cache shared across instances (ref `master_layout` caching)
+    _layout_cache = {}
+
+    def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
+                 attn_mask_mode="mul", max_seq_length=2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(
+            num_heads=4)
+        assert key_padding_mask_mode in ("add", "mul")
+        assert attn_mask_mode in ("add", "mul")
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self.max_seq_length = max_seq_length
+
+    def get_layout(self, seq_len):
+        key = (id(type(self.sparsity_config)),
+               self.sparsity_config.num_heads, self.sparsity_config.block,
+               seq_len, repr(sorted(self.sparsity_config.__dict__.items(),
+                                    key=lambda kv: kv[0])))
+        if key not in self._layout_cache:
+            self._layout_cache[key] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[key]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None, causal=False):
+        assert query.dtype in (jnp.float32, jnp.bfloat16, jnp.float16)
+        b, t, h, d = query.shape
+        layout = self.get_layout(t)
+        block = self.sparsity_config.block
+
+        uses_masks = (rpe is not None or key_padding_mask is not None or
+                      attn_mask is not None)
+        on_tpu = jax.default_backend() == "tpu"
+        if not uses_masks:
+            return block_sparse_attention(
+                query, key, value, layout, block, causal=causal,
+                interpret=not on_tpu)
+
+        # masked path: fold masks into an additive bias and run the
+        # dense-fallback math with the layout mask (exact, but O(T^2)
+        # memory — the reference's mask support has the same cost in
+        # its sparse softmax, `softmax.py:17-304`)
+        scale = 1.0 / np.sqrt(d)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", query, key).astype(
+            jnp.float32) * scale
+        lay_mask = layout_to_dense_mask(layout, t, block)
+        scores = jnp.where(jnp.asarray(lay_mask)[None], scores, NEG_INF)
+        if causal:
+            tri = np.tril(np.ones((t, t), dtype=bool))
+            scores = jnp.where(jnp.asarray(tri)[None, None], scores,
+                               NEG_INF)
+        if rpe is not None:
+            scores = scores + rpe.astype(jnp.float32)
+        if key_padding_mask is not None:
+            kp = key_padding_mask.astype(jnp.float32)[:, None, None, :]
+            if self.key_padding_mask_mode == "add":
+                scores = scores + kp
+            else:
+                scores = jnp.where(kp != 0, scores, NEG_INF)
+        if attn_mask is not None:
+            am = attn_mask.astype(jnp.float32)
+            while am.ndim < 4:
+                am = am[None]
+            if self.attn_mask_mode == "add":
+                scores = scores + am
+            else:
+                scores = jnp.where(am != 0, scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1).astype(value.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, value)
+
+
+class BertSparseSelfAttention(nn.Module):
+    """BERT-style self-attention block with block-sparse scores
+    (ref `bert_sparse_self_attention.py:9`)."""
+    hidden_size: int
+    num_attention_heads: int
+    sparsity_config: Any = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, hidden_states, attention_mask=None):
+        h = self.hidden_size
+        nh = self.num_attention_heads
+        assert h % nh == 0
+        hd = h // nh
+        b, t, _ = hidden_states.shape
+
+        def dense(name):
+            return nn.Dense(h, dtype=self.dtype, name=name)
+
+        q = dense("query")(hidden_states).reshape(b, t, nh, hd)
+        k = dense("key")(hidden_states).reshape(b, t, nh, hd)
+        v = dense("value")(hidden_states).reshape(b, t, nh, hd)
+        sparse_attn = SparseSelfAttention(
+            sparsity_config=self.sparsity_config or
+            FixedSparsityConfig(num_heads=nh),
+            key_padding_mask_mode="add", attn_mask_mode="mul")
+        ctx = sparse_attn(q, k, v, attn_mask=attention_mask)
+        return ctx.reshape(b, t, h)

@@ -39,6 +39,7 @@ from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
                                         build_mesh, data_sharding, replicated)
+from deepspeed_tpu.runtime.utils import _zeros_like_f32
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
 from deepspeed_tpu.runtime.zero.offload import ZeroOffloadMixin
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
@@ -81,11 +82,6 @@ def _global_norm(tree):
     leaves = [jnp.vdot(x.astype(jnp.float32), x.astype(jnp.float32))
               for x in jax.tree_util.tree_leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
-
-
-def _zeros_like_f32(tree):
-    return jax.tree_util.tree_map(
-        lambda x: jnp.zeros(x.shape, jnp.float32), tree)
 
 
 def _fetch_to_host(tree):
@@ -613,7 +609,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # jitted step functions
     # ------------------------------------------------------------------
     def _scaled_loss_fn(self, params, batch, rng, loss_scale, keep_prob):
-        gas = self.gradient_accumulation_steps()
+        gas = self._jit_gas()
         rngs = {"dropout": rng, "params": rng}
         kwargs = {}
         if self.progressive_layer_drop is not None:
@@ -934,7 +930,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         """Fast path: one fused jitted step over all grad-accum
         microbatches. Pass either an iterator yielding microbatches or a
         pre-stacked batch pytree with leading dim [gas, micro_bs, ...]."""
-        gas = self.gradient_accumulation_steps()
+        gas = self._jit_gas()
         if batch is None:
             assert data_iter is not None
             micro = [next(data_iter) for _ in range(gas)]
@@ -1117,6 +1113,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     np.asarray(optim_sd["scale"][0]))
                 scale = make_static_loss_scale_state(
                     self._host_scaler.cur_scale)
+            else:
+                # checkpoint written without offload: masters restore
+                # from the saved fp32 module weights; moments restart
+                logger.warning(
+                    "checkpoint has no host-offload optimizer state "
+                    "(saved without cpu_offload?); restoring masters "
+                    "from module weights, Adam moments reset")
+                from jax.flatten_util import ravel_pytree
+                flat, _ = ravel_pytree(params_f32)
+                self._host_master[:] = np.asarray(jax.device_get(flat))
         elif load_optimizer_states and optim_sd is not None:
             opt_state = jax.tree_util.tree_map(
                 lambda cur, saved: jax.device_put(

@@ -158,13 +158,7 @@ class PipelineEngine(DeepSpeedEngine):
                 lambda x: np.asarray(x).reshape(
                     (m, np.asarray(x).shape[0] // m) +
                     np.asarray(x).shape[1:]), batch)
-        saved_gas = self._config.gradient_accumulation_steps
-        self._config.gradient_accumulation_steps = self._jit_gas()
-        try:
-            loss = super().train_batch(batch=stacked)
-        finally:
-            self._config.gradient_accumulation_steps = saved_gas
-        return loss
+        return super().train_batch(batch=stacked)
 
     def eval_batch(self, data_iter=None, batch=None):
         # the SPMD pipelined loss consumes a full batch of micro_batches

@@ -160,3 +160,11 @@ def call_to_str(base, *args, **kwargs):
                           for key, arg in kwargs.items())
     name += ")"
     return name
+
+
+def _zeros_like_f32(tree):
+    """fp32 zeros pytree matching `tree`'s shapes (grad accumulators)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), tree)

@@ -11,12 +11,8 @@ import numpy as np
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.fp16.loss_scaler import (
     make_static_loss_scale_state)
+from deepspeed_tpu.runtime.utils import _zeros_like_f32
 from deepspeed_tpu.utils.logging import log_dist
-
-
-def _zeros_like_f32(tree):
-    return jax.tree_util.tree_map(
-        lambda x: jnp.zeros(x.shape, jnp.float32), tree)
 
 
 class ZeroOffloadMixin:

@@ -70,6 +70,18 @@ class OpBuilder:
             with open(src, "rb") as f:
                 h.update(f.read())
         h.update(" ".join(self.cxx_args()).encode())
+        # -march=native binaries are host-specific: key the cache on the
+        # CPU's feature flags so a cache dir shared across machines (or
+        # accidentally committed) never serves a foreign-ISA .so
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        h.update(line.encode())
+                        break
+        except OSError:
+            import platform
+            h.update(platform.processor().encode())
         return h.hexdigest()[:16]
 
     def _lib_path(self):

@@ -1,0 +1,123 @@
+"""Launcher CLI tests (parity target: ref tests/unit/test_run.py —
+hostfile parsing + include/exclude filtering)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (fetch_hostfile,
+                                           parse_inclusion_exclusion,
+                                           encode_world_info,
+                                           decode_world_info,
+                                           parse_args)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    p = tmp_path / "hostfile"
+    p.write_text(
+        "worker-0 slots=4\n"
+        "worker-1 slots=4\n"
+        "# comment line\n"
+        "\n"
+        "worker-2 slots=2\n")
+    return str(p)
+
+
+def test_fetch_hostfile(hostfile):
+    pool = fetch_hostfile(hostfile)
+    assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 2}
+
+
+def test_fetch_hostfile_missing():
+    assert fetch_hostfile("/nonexistent/hostfile") is None
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    p = tmp_path / "bad"
+    p.write_text("worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    p = tmp_path / "dup"
+    p.write_text("worker-0 slots=4\nworker-0 slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(str(p))
+
+
+def test_include_filter(hostfile):
+    pool = fetch_hostfile(hostfile)
+    active = parse_inclusion_exclusion(pool, "worker-0:0,2@worker-1", "")
+    assert active == {"worker-0": [0, 2], "worker-1": [0, 1, 2, 3]}
+
+
+def test_exclude_filter(hostfile):
+    pool = fetch_hostfile(hostfile)
+    active = parse_inclusion_exclusion(pool, "", "worker-1@worker-0:1")
+    assert active == {"worker-0": [0, 2, 3], "worker-2": [0, 1]}
+
+
+def test_include_exclude_mutually_exclusive(hostfile):
+    pool = fetch_hostfile(hostfile)
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "worker-0", "worker-1")
+
+
+def test_unknown_host_rejected(hostfile):
+    pool = fetch_hostfile(hostfile)
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "worker-9", "")
+
+
+def test_bad_slot_rejected(hostfile):
+    pool = fetch_hostfile(hostfile)
+    with pytest.raises(ValueError):
+        parse_inclusion_exclusion(pool, "worker-2:0,3", "")
+
+
+def test_world_info_roundtrip():
+    info = {"worker-0": [0, 1], "worker-1": [0]}
+    assert decode_world_info(encode_world_info(info)) == info
+
+
+def test_parse_args_remainder():
+    args = parse_args(["--num_nodes", "2", "train.py",
+                       "--deepspeed", "--lr", "0.1"])
+    assert args.user_script == "train.py"
+    assert args.user_args == ["--deepspeed", "--lr", "0.1"]
+    assert args.num_nodes == 2
+
+
+def test_env_report_runs():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.env_report"],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "cpu_adam" in out.stdout
+    assert "jax version" in out.stdout
+
+
+def test_ds_elastic_cli(tmp_path):
+    cfg = tmp_path / "ds.json"
+    cfg.write_text("""{
+      "elasticity": {"enabled": true, "max_train_batch_size": 2000,
+                     "micro_batch_sizes": [2, 4],
+                     "min_gpus": 1, "max_gpus": 64,
+                     "min_time": 20, "version": 0.1}
+    }""")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    out = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.elasticity",
+         "-c", str(cfg), "-w", "8"],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    assert "final_batch_size" in out.stdout

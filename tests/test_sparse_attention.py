@@ -1,0 +1,152 @@
+"""Sparse attention tests (parity target: ref
+tests/unit/test_sparse_attention.py compares block-sparse ops vs dense
+references with layout masks)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (
+    DenseSparsityConfig, FixedSparsityConfig, VariableSparsityConfig,
+    BigBirdSparsityConfig, BSLongformerSparsityConfig,
+    block_sparse_attention, layout_to_dense_mask, SparseSelfAttention,
+    BertSparseSelfAttention, SparseAttentionUtils)
+from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+    block_sparse_attention_dense_fallback)
+
+BLOCK = 32  # small block for CPU-interpret tests (TPU default is 128)
+H, T, D = 2, 256, 32
+
+
+def qkv(b=1, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(b, T, H, D), jnp.float32)
+            for _ in range(3)]
+
+
+ALL_CONFIGS = [
+    DenseSparsityConfig(num_heads=H, block=BLOCK),
+    FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                        num_global_blocks=1),
+    FixedSparsityConfig(num_heads=H, block=BLOCK, num_local_blocks=2,
+                        attention="unidirectional"),
+    VariableSparsityConfig(num_heads=H, block=BLOCK,
+                           local_window_blocks=[1, 2],
+                           global_block_indices=[0]),
+    BigBirdSparsityConfig(num_heads=H, block=BLOCK, num_random_blocks=1,
+                          num_sliding_window_blocks=3, num_global_blocks=1),
+    BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                               num_sliding_window_blocks=3,
+                               global_block_indices=[0]),
+]
+
+
+@pytest.mark.parametrize("cfg", ALL_CONFIGS,
+                         ids=lambda c: type(c).__name__)
+def test_layout_shape_and_coverage(cfg):
+    layout = cfg.make_layout(T)
+    nb = T // BLOCK
+    assert layout.shape == (H, nb, nb)
+    assert set(np.unique(layout)) <= {0, 1}
+    # every query block attends somewhere
+    assert (layout.sum(-1) > 0).all()
+    # diagonal present (needed for causal use)
+    assert layout[:, np.arange(nb), np.arange(nb)].all()
+
+
+def test_layout_seq_len_must_divide():
+    cfg = FixedSparsityConfig(num_heads=H, block=BLOCK)
+    with pytest.raises(ValueError):
+        cfg.make_layout(T + 1)
+
+
+@pytest.mark.parametrize("cfg", ALL_CONFIGS[:4],
+                         ids=lambda c: type(c).__name__)
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_matches_dense_fallback(cfg, causal):
+    q, k, v = qkv()
+    layout = cfg.make_layout(T)
+    out = block_sparse_attention(q, k, v, layout, BLOCK, causal=causal)
+    ref = block_sparse_attention_dense_fallback(q, k, v, layout, BLOCK,
+                                                causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_grads_match_dense_fallback():
+    q, k, v = qkv(seed=7)
+    layout = FixedSparsityConfig(
+        num_heads=H, block=BLOCK, num_local_blocks=2).make_layout(T)
+
+    def loss_sparse(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, layout, BLOCK) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(block_sparse_attention_dense_fallback(
+            q, k, v, layout, BLOCK) ** 2)
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_dense_config_equals_full_attention():
+    from deepspeed_tpu.ops.transformer.flash_attention import dense_attention
+    q, k, v = qkv()
+    layout = DenseSparsityConfig(num_heads=H, block=BLOCK).make_layout(T)
+    out = block_sparse_attention(q, k, v, layout, BLOCK, causal=False)
+    ref = dense_attention(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_self_attention_module():
+    attn = SparseSelfAttention(
+        sparsity_config=FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                            num_local_blocks=2))
+    q, k, v = qkv()
+    out = attn(q, k, v)
+    assert out.shape == q.shape
+    # key padding mask path (mask second half of keys)
+    kp = jnp.zeros((1, T)).at[:, T // 2:].set(-1e9)
+    out_masked = attn(q, k, v, key_padding_mask=kp)
+    assert not np.allclose(np.asarray(out), np.asarray(out_masked))
+
+
+def test_bert_sparse_self_attention_trains():
+    module = BertSparseSelfAttention(
+        hidden_size=64, num_attention_heads=H,
+        sparsity_config=FixedSparsityConfig(num_heads=H, block=BLOCK,
+                                            num_local_blocks=2))
+    x = jnp.asarray(np.random.RandomState(0).randn(1, T, 64), jnp.float32)
+    params = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(params, x)
+    assert out.shape == (1, T, 64)
+    grads = jax.grad(
+        lambda p: jnp.sum(module.apply(p, x) ** 2))(params)
+    assert all(float(jnp.max(jnp.abs(l))) > 0
+               for l in jax.tree_util.tree_leaves(grads))
+
+
+def test_pad_to_block_size():
+    ids = jnp.ones((2, 100), jnp.int32)
+    mask = jnp.ones((2, 100), jnp.int32)
+    pad_len, ids_p, mask_p, _, _, _ = SparseAttentionUtils.pad_to_block_size(
+        block_size=64, input_ids=ids, attention_mask=mask, pad_token_id=9)
+    assert pad_len == 28
+    assert ids_p.shape == (2, 128)
+    assert int(ids_p[0, -1]) == 9 and int(mask_p[0, -1]) == 0
+    out = SparseAttentionUtils.unpad_sequence_output(
+        pad_len, jnp.zeros((2, 128, 8)))
+    assert out.shape == (2, 100, 8)
+
+
+def test_extend_position_embedding():
+    pe = jnp.asarray(np.random.randn(128, 16), jnp.float32)
+    ext = SparseAttentionUtils.extend_position_embedding(pe, 300)
+    assert ext.shape == (300, 16)
+    np.testing.assert_array_equal(np.asarray(ext[:128]), np.asarray(pe))
+    np.testing.assert_array_equal(np.asarray(ext[128:256]), np.asarray(pe))
