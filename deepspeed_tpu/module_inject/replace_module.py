@@ -1,0 +1,162 @@
+"""Module injection: swap HF-style BERT layers for the fused layer.
+
+Counterpart of `deepspeed/module_inject/replace_module.py:6-193`. In
+torch, injection mutates `nn.Module` objects in place; under JAX the
+model is (module defn, param tree), so injection is *param-tree
+surgery*: convert an HF Flax BERT layer's parameters into the fused
+`DeepSpeedTransformerLayer` layout (concatenating q/k/v into one
+[H, 3H] qkv kernel, exactly the weight transplant of ref
+`replace_module.py:34-56`), and run the fused module in its place.
+`revert_transformer_layer` is the inverse (ref `:93`). The generic
+`replace_module` walker (ref `:161-193`) applies any policy over a
+param tree.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
+                                           DeepSpeedTransformerConfig)
+from deepspeed_tpu.utils.logging import logger
+
+
+def _is_hf_bert_layer(subtree) -> bool:
+    try:
+        return "query" in subtree["attention"]["self"] and \
+            "dense" in subtree["intermediate"]
+    except (KeyError, TypeError):
+        return False
+
+
+def convert_bert_layer_params(hf_layer):
+    """HF FlaxBertLayer params -> DeepSpeedTransformerLayer params
+    (the q/k/v concat of ref `replace_module.py:34-56`)."""
+    attn_self = hf_layer["attention"]["self"]
+    attn_out = hf_layer["attention"]["output"]
+    qkv_kernel = jnp.concatenate(
+        [attn_self["query"]["kernel"], attn_self["key"]["kernel"],
+         attn_self["value"]["kernel"]], axis=-1)
+    qkv_bias = jnp.concatenate(
+        [attn_self["query"]["bias"], attn_self["key"]["bias"],
+         attn_self["value"]["bias"]], axis=-1)
+    return {"core": {
+        "attn_qkvw": {"kernel": qkv_kernel, "bias": qkv_bias},
+        "attn_ow": {"kernel": attn_out["dense"]["kernel"],
+                    "bias": attn_out["dense"]["bias"]},
+        "attn_layer_norm": {"scale": attn_out["LayerNorm"]["scale"],
+                            "bias": attn_out["LayerNorm"]["bias"]},
+        "inter_w": {"kernel": hf_layer["intermediate"]["dense"]["kernel"],
+                    "bias": hf_layer["intermediate"]["dense"]["bias"]},
+        "output_w": {"kernel": hf_layer["output"]["dense"]["kernel"],
+                     "bias": hf_layer["output"]["dense"]["bias"]},
+        "layer_norm": {"scale": hf_layer["output"]["LayerNorm"]["scale"],
+                       "bias": hf_layer["output"]["LayerNorm"]["bias"]},
+    }}
+
+
+def revert_bert_layer_params(ds_layer):
+    """DeepSpeedTransformerLayer params -> HF FlaxBertLayer params
+    (ref `replace_module.py:93`)."""
+    core = ds_layer["core"]
+    qkv_kernel = core["attn_qkvw"]["kernel"]
+    qkv_bias = core["attn_qkvw"]["bias"]
+    qk, kk, vk = jnp.split(qkv_kernel, 3, axis=-1)
+    qb, kb, vb = jnp.split(qkv_bias, 3, axis=-1)
+    return {
+        "attention": {
+            "self": {
+                "query": {"kernel": qk, "bias": qb},
+                "key": {"kernel": kk, "bias": kb},
+                "value": {"kernel": vk, "bias": vb},
+            },
+            "output": {
+                "dense": {"kernel": core["attn_ow"]["kernel"],
+                          "bias": core["attn_ow"]["bias"]},
+                "LayerNorm": {"scale": core["attn_layer_norm"]["scale"],
+                              "bias": core["attn_layer_norm"]["bias"]},
+            },
+        },
+        "intermediate": {
+            "dense": {"kernel": core["inter_w"]["kernel"],
+                      "bias": core["inter_w"]["bias"]},
+        },
+        "output": {
+            "dense": {"kernel": core["output_w"]["kernel"],
+                      "bias": core["output_w"]["bias"]},
+            "LayerNorm": {"scale": core["layer_norm"]["scale"],
+                          "bias": core["layer_norm"]["bias"]},
+        },
+    }
+
+
+def replace_module(params, policy: Callable[[tuple, Any], Optional[Any]]):
+    """Generic recursive walker (ref `replace_module.py:161-193`):
+    `policy(path, subtree)` returns a replacement subtree or None to
+    recurse. Returns (new_tree, replaced_count)."""
+    count = 0
+
+    def walk(path, node):
+        nonlocal count
+        if isinstance(node, dict):
+            replacement = policy(path, node)
+            if replacement is not None:
+                count += 1
+                return replacement
+            return {k: walk(path + (k,), v) for k, v in node.items()}
+        return node
+
+    return walk((), params), count
+
+
+def replace_transformer_layer(orig_layer_impl=None, model=None,
+                              params=None, config=None,
+                              micro_batch_size=-1, bert_config=None,
+                              seed=-1, preln=False, fp16=False,
+                              training=True):
+    """Convert every HF BERT layer in `params` to fused-layer params
+    (ref `replace_transformer_layer`, `replace_module.py:6`).
+
+    Returns (transformer_config, new_params, num_replaced). Run the
+    converted layers with DeepSpeedTransformerLayer(transformer_config).
+    """
+    assert params is not None, "pass the HF model's param tree as params="
+    hidden = None
+    heads = None
+    if bert_config is not None:
+        hidden = getattr(bert_config, "hidden_size", None)
+        heads = getattr(bert_config, "num_attention_heads", None)
+
+    def policy(path, node):
+        if _is_hf_bert_layer(node):
+            return convert_bert_layer_params(node)
+        return None
+
+    new_params, count = replace_module(params, policy)
+    if count == 0:
+        logger.warning("replace_transformer_layer: no BERT layers found")
+    if hidden is None and count > 0:
+        # infer geometry from the first converted layer
+        leaf = jax.tree_util.tree_leaves(new_params)[0]
+    ds_config = config or DeepSpeedTransformerConfig(
+        hidden_size=hidden or -1,
+        heads=heads or -1,
+        pre_layer_norm=preln,
+        fp16=fp16,
+        training=training)
+    return ds_config, new_params, count
+
+
+def revert_transformer_layer(params):
+    """Inverse conversion over a whole tree (ref `replace_module.py:93`)."""
+    def policy(path, node):
+        if isinstance(node, dict) and "core" in node and \
+                isinstance(node.get("core"), dict) and \
+                "attn_qkvw" in node["core"]:
+            return revert_bert_layer_params(node)
+        return None
+
+    new_params, count = replace_module(params, policy)
+    return new_params, count

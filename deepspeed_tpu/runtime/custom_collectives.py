@@ -1,0 +1,11 @@
+"""Compressed collectives (ref `runtime/custom_collectives.py`: MPI/cupy
+igather/allgather helpers for 1-bit Adam). On TPU the compressed
+allreduce is a bit-packed `all_gather` over the mesh's data axis —
+implemented in `runtime/fp16/onebit_adam.py` and re-exported here for
+component parity."""
+
+from deepspeed_tpu.runtime.fp16.onebit_adam import (
+    pack_signs, unpack_signs, compress, compressed_allreduce)
+
+__all__ = ["pack_signs", "unpack_signs", "compress",
+           "compressed_allreduce"]

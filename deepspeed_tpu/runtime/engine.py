@@ -433,8 +433,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         weight_decay = params.get("weight_decay", 0.0)
         self._base_lr = lr
 
-        if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER,
-                    C.ONEBIT_ADAM_OPTIMIZER):
+        if name == C.ONEBIT_ADAM_OPTIMIZER:
+            # 1-bit Adam (ref onebit_adam.py:18): freeze_step warmup then
+            # sign-compressed momentum with error feedback
+            from deepspeed_tpu.runtime.fp16.onebit_adam import onebit_adam
+            return onebit_adam(
+                learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+                weight_decay=weight_decay,
+                freeze_step=params.get("freeze_step", 100))
+        if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
             # FusedAdam defaults to adam_w_mode (ref ops/adam/fused_adam.py);
             # decoupled weight decay is the TPU-native choice too.
             adam_w_mode = params.get("adam_w_mode", True) or \
@@ -565,7 +572,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         opt_target = master if self.mixed_precision else params
         opt_state = self.optimizer_transform.init(opt_target)
-        if self.lr_scheduler is not None and self._base_lr is None and \
+        if self.lr_scheduler is not None and \
                 "learning_rate" not in getattr(opt_state, "hyperparams", {}):
             logger.warning(
                 "an LR scheduler is configured but the client optimizer "

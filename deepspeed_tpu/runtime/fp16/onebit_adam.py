@@ -1,0 +1,199 @@
+"""1-bit Adam: error-compensated sign-compressed momentum communication.
+
+Counterpart of `deepspeed/runtime/fp16/onebit_adam.py:18,104` +
+`runtime/custom_collectives.py` (mpi4py/cupy compressed gather). The
+algorithm (Tang et al.): run plain Adam for `freeze_step` warmup steps,
+then freeze the variance term and communicate only the *momentum*,
+compressed to sign bits + one scale, with error feedback on both the
+worker and server side.
+
+TPU-native form: the compressed allreduce is a real bit-packed
+collective — signs pack 8-to-a-uint8 (`pack_signs`) and ride a single
+`all_gather` over the `data` axis inside `shard_map`, so the wire volume
+is 1/32 of fp32 + one scalar per worker (the 5x comm saving the
+reference claims lands as ~32x on the sign payload; valuable on DCN
+between TPU slices, rarely needed on ICI — SURVEY §7). Error feedback
+buffers live in the optimizer state exactly like the reference's
+`worker_error`/`server_error` (ref `onebit_adam.py:104-230`).
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def pack_signs(x):
+    """[N] float -> ceil(N/8) uint8 of sign bits (1 = non-negative)."""
+    n = x.shape[0]
+    pad = (-n) % 8
+    bits = (x >= 0).astype(jnp.uint8)
+    if pad:
+        bits = jnp.concatenate([bits, jnp.zeros((pad,), jnp.uint8)])
+    bits = bits.reshape(-1, 8)
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+    return jnp.sum(bits * weights, axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed, n):
+    """ceil(N/8) uint8 -> [N] float32 of ±1."""
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], jnp.uint8)
+    bits = (packed[:, None] & weights[None, :]) > 0
+    flat = bits.reshape(-1)[:n]
+    return jnp.where(flat, 1.0, -1.0).astype(jnp.float32)
+
+
+def compress(x, error):
+    """Error-feedback sign compression: returns (scale, packed_signs,
+    new_error). scale * sign reconstructs the transmitted tensor."""
+    corrected = x + error
+    scale = jnp.mean(jnp.abs(corrected))
+    signs = jnp.where(corrected >= 0, 1.0, -1.0)
+    new_error = corrected - scale * signs
+    return scale, pack_signs(corrected), new_error
+
+
+def compressed_allreduce(x, worker_error, server_error, axis_name):
+    """Two-stage compressed allreduce of flat `x` over `axis_name`
+    (ref `Compressed_Allreduce`, `onebit_adam.py:104-230`): worker-side
+    sign compression -> bit-packed all_gather -> average -> server-side
+    sign compression (shared second-stage error feedback).
+
+    Must run inside shard_map over `axis_name`. Returns
+    (result, new_worker_error, new_server_error)."""
+    n = x.shape[0]
+    scale, packed, new_worker_error = compress(x, worker_error)
+    # the wire payload: uint8 sign bits + one f32 scale per worker
+    all_packed = jax.lax.all_gather(packed, axis_name)      # [W, N/8]
+    all_scales = jax.lax.all_gather(scale, axis_name)       # [W]
+    w = all_packed.shape[0]
+    decoded = jax.vmap(lambda p, s: unpack_signs(p, n) * s)(
+        all_packed, all_scales)                             # [W, N]
+    avg = jnp.mean(decoded, axis=0)
+    # server-side compression (every worker computes it identically, so
+    # the reference's server allgather is free under SPMD)
+    s_scale, s_packed, new_server_error = compress(avg, server_error)
+    result = unpack_signs(s_packed, n) * s_scale
+    return result, new_worker_error, new_server_error
+
+
+class OnebitAdamState(NamedTuple):
+    count: jnp.ndarray
+    exp_avg: optax.Updates        # momentum (the communicated tensor)
+    exp_avg_sq: optax.Updates     # variance, frozen after freeze_step
+    worker_error: optax.Updates
+    server_error: optax.Updates
+
+
+def onebit_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, freeze_step=100,
+                axis_name: Optional[str] = None):
+    """optax transformation implementing 1-bit Adam
+    (ref `OnebitAdam`, `onebit_adam.py:18`).
+
+    axis_name: data axis for the compressed allreduce when the update
+    runs inside shard_map. None = single-worker form (W=1): momentum is
+    still sign-compressed with error feedback after freeze_step, which
+    preserves the algorithm's convergence behavior without collectives.
+    """
+
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return OnebitAdamState(
+            count=jnp.zeros([], jnp.int32),
+            exp_avg=zeros(), exp_avg_sq=zeros(),
+            worker_error=zeros(), server_error=zeros())
+
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+
+        def warm_moment(m, g):
+            return b1 * m + (1 - b1) * g
+
+        def warm_var(v, g):
+            return b2 * v + (1 - b2) * g * g
+
+        exp_avg_warm = jax.tree_util.tree_map(warm_moment, state.exp_avg,
+                                              updates)
+        exp_avg_sq_warm = jax.tree_util.tree_map(warm_var,
+                                                 state.exp_avg_sq, updates)
+
+        # compressed phase: momentum update then sign-compress with
+        # error feedback (variance frozen)
+        def compressed_moment(m, g, werr, serr):
+            m_new = b1 * m + (1 - b1) * g
+            flat = m_new.reshape(-1)
+            if axis_name is not None:
+                out, werr_new, serr_new = compressed_allreduce(
+                    flat, werr.reshape(-1), serr.reshape(-1), axis_name)
+            else:
+                scale, packed, werr_new = compress(flat, werr.reshape(-1))
+                out = unpack_signs(packed, flat.shape[0]) * scale
+                serr_new = serr.reshape(-1)
+            return (out.reshape(m.shape), werr_new.reshape(m.shape),
+                    serr_new.reshape(m.shape))
+
+        comp = jax.tree_util.tree_map(
+            compressed_moment, state.exp_avg, updates,
+            state.worker_error, state.server_error)
+        # unzip the 3-tuples
+        treedef = jax.tree_util.tree_structure(state.exp_avg)
+        flat_comp = treedef.flatten_up_to(comp)
+        exp_avg_comp = treedef.unflatten([c[0] for c in flat_comp])
+        werr_new = treedef.unflatten([c[1] for c in flat_comp])
+        serr_new = treedef.unflatten([c[2] for c in flat_comp])
+
+        pick = lambda a, b: jax.tree_util.tree_map(
+            lambda x, y: jnp.where(in_warmup, x, y), a, b)
+        exp_avg = pick(exp_avg_warm, exp_avg_comp)
+        exp_avg_sq = pick(exp_avg_sq_warm, state.exp_avg_sq)
+        worker_error = pick(state.worker_error, werr_new)
+        server_error = pick(state.server_error, serr_new)
+
+        bias1 = 1 - b1 ** count.astype(jnp.float32)
+        bias2 = 1 - b2 ** jnp.minimum(
+            count, freeze_step).astype(jnp.float32)
+
+        def step_update(m, v, p):
+            denom = jnp.sqrt(v / bias2) + eps
+            upd = -(learning_rate / bias1) * (m / denom)
+            if weight_decay:
+                upd = upd - learning_rate * weight_decay * p
+            return upd
+
+        new_updates = jax.tree_util.tree_map(
+            step_update, exp_avg, exp_avg_sq,
+            params if params is not None else exp_avg)
+        return new_updates, OnebitAdamState(
+            count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
+            worker_error=worker_error, server_error=server_error)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class OnebitAdam:
+    """Class-style facade (ref `OnebitAdam`): holds the transformation
+    plus the reference's hyperparameter surface."""
+
+    def __init__(self, params=None, lr=1e-3, freeze_step=100,
+                 betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                 cuda_aware=False, axis_name=None):
+        if cuda_aware:
+            logger.warning("cuda_aware is meaningless on TPU; ignored")
+        self.transformation = onebit_adam(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay, freeze_step=freeze_step,
+            axis_name=axis_name)
+        self.freeze_step = freeze_step
+
+    def init(self, params):
+        return self.transformation.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.transformation.update(grads, state, params)

@@ -1,0 +1,151 @@
+"""BERT family + module injection tests (parity targets: ref vendored
+modeling.py BERT comparisons and module_inject tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.bert import (BertForPreTrainingLM, BertModel,
+                                       tiny_bert_config, bert_config)
+from deepspeed_tpu.module_inject import (convert_bert_layer_params,
+                                         revert_bert_layer_params,
+                                         replace_transformer_layer,
+                                         revert_transformer_layer)
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
+                                           DeepSpeedTransformerConfig)
+
+
+def make_batch(bs=8, t=64, vocab=256, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(0, vocab, (bs, t)).astype(np.int32)
+    labels = np.where(rng.rand(bs, t) < 0.15, ids, -100).astype(np.int32)
+    return {"input_ids": ids,
+            "attention_mask": np.ones((bs, t), np.int32),
+            "token_type_ids": np.zeros((bs, t), np.int32),
+            "masked_lm_labels": labels,
+            "next_sentence_label": rng.randint(0, 2, (bs,)).astype(np.int32)}
+
+
+def test_bert_pretraining_trains():
+    cfg = tiny_bert_config()
+    model = BertForPreTrainingLM(cfg)
+    batch = make_batch()
+    params = model.init(jax.random.PRNGKey(0), batch)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}})
+    losses = []
+    for i in range(8):
+        loss = engine.train_batch(batch={k: v[None] for k, v in
+                                         batch.items()})
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bert_attention_mask_matters():
+    cfg = tiny_bert_config()
+    module = BertModel(cfg)
+    ids = np.random.RandomState(0).randint(0, 256, (2, 64)).astype(np.int32)
+    mask = np.ones((2, 64), np.int32)
+    params = module.init({"params": jax.random.PRNGKey(0)}, ids, mask,
+                         deterministic=True)
+    seq_full, _ = module.apply(params, ids, mask, deterministic=True)
+    mask2 = mask.copy()
+    mask2[:, 32:] = 0
+    seq_masked, _ = module.apply(params, ids, mask2, deterministic=True)
+    assert not np.allclose(np.asarray(seq_full), np.asarray(seq_masked))
+
+
+def _fake_hf_bert_layer(h=64, inter=128, seed=0):
+    rng = np.random.RandomState(seed)
+
+    def dense(i, o):
+        return {"kernel": jnp.asarray(rng.randn(i, o) * 0.02, jnp.float32),
+                "bias": jnp.zeros((o,), jnp.float32)}
+
+    def ln(n):
+        return {"scale": jnp.ones((n,), jnp.float32),
+                "bias": jnp.zeros((n,), jnp.float32)}
+
+    return {
+        "attention": {
+            "self": {"query": dense(h, h), "key": dense(h, h),
+                     "value": dense(h, h)},
+            "output": {"dense": dense(h, h), "LayerNorm": ln(h)},
+        },
+        "intermediate": {"dense": dense(h, inter)},
+        "output": {"dense": dense(inter, h), "LayerNorm": ln(h)},
+    }
+
+
+def test_convert_revert_roundtrip():
+    hf = _fake_hf_bert_layer()
+    ds = convert_bert_layer_params(hf)
+    assert ds["core"]["attn_qkvw"]["kernel"].shape == (64, 192)
+    back = revert_bert_layer_params(ds)
+    for a, b in zip(jax.tree_util.tree_leaves(hf),
+                    jax.tree_util.tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_converted_layer_matches_hf_math():
+    """The fused layer with converted params must reproduce the HF BERT
+    layer computation (the criterion of ref test_cuda_forward.py)."""
+    h, nh, inter, t = 64, 4, 128, 64
+    hf = _fake_hf_bert_layer(h, inter)
+    ds_params = convert_bert_layer_params(hf)
+    cfg = DeepSpeedTransformerConfig(
+        hidden_size=h, intermediate_size=inter, heads=nh,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        num_hidden_layers=1, pre_layer_norm=False, training=False,
+        layer_norm_eps=1e-12)
+    layer = DeepSpeedTransformerLayer(cfg)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, t, h), jnp.float32)
+    out = layer.apply({"params": ds_params}, x, None, True)
+
+    # reference HF-style post-LN BERT layer math
+    def d(p, v):
+        return v @ p["kernel"] + p["bias"]
+
+    def lnorm(p, v, eps=1e-12):
+        mu = v.mean(-1, keepdims=True)
+        var = ((v - mu) ** 2).mean(-1, keepdims=True)
+        return (v - mu) / np.sqrt(var + eps) * p["scale"] + p["bias"]
+
+    q = d(hf["attention"]["self"]["query"], x).reshape(2, t, nh, h // nh)
+    k = d(hf["attention"]["self"]["key"], x).reshape(2, t, nh, h // nh)
+    v = d(hf["attention"]["self"]["value"], x).reshape(2, t, nh, h // nh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(h // nh)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(2, t, h)
+    attn = lnorm(hf["attention"]["output"]["LayerNorm"],
+                 x + d(hf["attention"]["output"]["dense"], ctx))
+    mlp = d(hf["output"]["dense"],
+            jax.nn.gelu(d(hf["intermediate"]["dense"], attn),
+                        approximate=False))
+    ref = lnorm(hf["output"]["LayerNorm"], attn + mlp)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_replace_transformer_layer_tree_walk():
+    tree = {
+        "embeddings": {"word": {"kernel": jnp.zeros((10, 64))}},
+        "encoder": {"layer": {
+            "0": _fake_hf_bert_layer(seed=0),
+            "1": _fake_hf_bert_layer(seed=1),
+        }},
+    }
+    cfg, new_tree, count = replace_transformer_layer(
+        params=tree, bert_config=None)
+    assert count == 2
+    assert "attn_qkvw" in new_tree["encoder"]["layer"]["0"]["core"]
+    assert "word" in new_tree["embeddings"]  # untouched
+    reverted, rcount = revert_transformer_layer(new_tree)
+    assert rcount == 2
+    assert "query" in reverted["encoder"]["layer"]["0"]["attention"]["self"]
