@@ -104,3 +104,7 @@ def add_config_arguments(parser):
 # DeepSpeedTransformerConfig live at package root).
 from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
                                            DeepSpeedTransformerConfig)
+# `deepspeed.checkpointing` module alias (ref exposes the activation-
+# checkpointing module at package level).
+from deepspeed_tpu.runtime.activation_checkpointing import \
+    checkpointing  # noqa: F401

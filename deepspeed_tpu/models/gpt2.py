@@ -41,6 +41,11 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16       # compute dtype
     param_dtype: Any = jnp.float32  # storage dtype of trainable params
     remat: bool = True              # activation-checkpoint each block
+    # Selective rematerialisation: name of a jax.checkpoint_policies
+    # policy (e.g. "dots_with_no_batch_dims_saveable" keeps weight-matmul
+    # outputs and recomputes only the cheap elementwise chain) or None
+    # for full-block remat.
+    remat_policy: Optional[str] = None
     attention_impl: str = "auto"    # auto | pallas | xla
     initializer_range: float = 0.02
 
@@ -152,7 +157,8 @@ class GPT2LMHeadModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, deterministic: bool = True,
-                 layer_keep_prob: Optional[jnp.ndarray] = None):
+                 layer_keep_prob: Optional[jnp.ndarray] = None,
+                 return_hidden: bool = False):
         cfg = self.config
         b, t = input_ids.shape
 
@@ -188,6 +194,10 @@ class GPT2LMHeadModel(nn.Module):
                               dtype=jnp.float32,
                               param_dtype=cfg.param_dtype,
                               name="ln_f")(hidden)
+        if return_hidden:
+            # fused-head path: the caller computes loss chunkwise against
+            # wte without materialising [B, T, vocab] logits
+            return hidden.astype(cfg.dtype), wte
         logits = jnp.einsum("btc,vc->btv", hidden.astype(cfg.dtype),
                             wte.astype(cfg.dtype))
         return logits
@@ -203,8 +213,11 @@ class _BlockScanCell(nn.Module):
         cfg = self.config
         block_cls = GPT2Block
         if cfg.remat:
+            policy = None
+            if cfg.remat_policy is not None:
+                policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
             block_cls = nn.remat(GPT2Block, prevent_cse=False,
-                                 static_argnums=(2,))
+                                 static_argnums=(2,), policy=policy)
         out = block_cls(cfg)(hidden, deterministic)
         if keep_prob is not None:
             if deterministic:
@@ -214,6 +227,47 @@ class _BlockScanCell(nn.Module):
                                             keep_prob)
                 out = jnp.where(gate, out, hidden)
         return out, None
+
+
+def chunked_tied_head_loss(hidden, wte, labels, ignore_index=-100,
+                           chunk_tokens=1024):
+    """Tied-embedding LM head + token CE without ever materialising the
+    full [B, T, vocab] logits (at 50k vocab that is gigabytes in fp32 and
+    was the single biggest activation in the train step).
+
+    Scans over token chunks: each step computes a [chunk, vocab] logits
+    tile on the MXU with fp32 accumulation, reduces it to (nll_sum,
+    valid_count), and is `jax.checkpoint`-ed so the backward pass
+    recomputes the tile instead of saving it.
+    """
+    b, t, c = hidden.shape
+    n = b * t
+    h = hidden.reshape(n, c)
+    lab = labels.reshape(n)
+    pad = (-n) % chunk_tokens
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, c), h.dtype)])
+        lab = jnp.concatenate(
+            [lab, jnp.full((pad,), ignore_index, lab.dtype)])
+    h = h.reshape(-1, chunk_tokens, c)
+    lab = lab.reshape(-1, chunk_tokens)
+    wte_c = wte.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        hc, lc = xs
+        logits = jnp.einsum("tc,vc->tv", hc, wte_c,
+                            preferred_element_type=jnp.float32)
+        valid = lc != ignore_index
+        safe = jnp.where(valid, lc, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+        nll = jnp.where(valid, logz - gold, 0.0)
+        return (carry[0] + nll.sum(), carry[1] + valid.sum()), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (h, lab))
+    return total / jnp.maximum(count, 1)
 
 
 def cross_entropy_loss(logits, labels, ignore_index=-100):
@@ -257,10 +311,11 @@ class GPT2ForCausalLM:
         kwargs = {}
         if layer_keep_prob is not None:
             kwargs["layer_keep_prob"] = layer_keep_prob
-        logits = self.module.apply({"params": params}, input_ids,
-                                   deterministic,
-                                   rngs=rngs or {}, **kwargs)
-        return cross_entropy_loss(logits, labels)
+        hidden, wte = self.module.apply({"params": params}, input_ids,
+                                        deterministic,
+                                        rngs=rngs or {},
+                                        return_hidden=True, **kwargs)
+        return chunked_tied_head_loss(hidden, wte, labels)
 
     def apply(self, params, input_ids, deterministic=True):
         return self.module.apply({"params": params}, input_ids, deterministic)

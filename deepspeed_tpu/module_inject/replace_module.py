@@ -134,15 +134,30 @@ def replace_transformer_layer(orig_layer_impl=None, model=None,
             return convert_bert_layer_params(node)
         return None
 
-    new_params, count = replace_module(params, policy)
+    converted_kernels = []
+
+    def policy2(path, node):
+        out = policy(path, node)
+        if out is not None:
+            converted_kernels.append(out["core"]["attn_qkvw"]["kernel"])
+        return out
+
+    new_params, count = replace_module(params, policy2)
     if count == 0:
         logger.warning("replace_transformer_layer: no BERT layers found")
-    if hidden is None and count > 0:
-        # infer geometry from the first converted layer
-        leaf = jax.tree_util.tree_leaves(new_params)[0]
+    if config is None and hidden is None and count > 0:
+        # infer geometry from the converted qkv kernel: [hidden, 3*hidden]
+        hidden = int(converted_kernels[0].shape[0])
+    if config is None and heads is None and hidden is not None:
+        # BERT-family models universally use head_dim=64; pass
+        # bert_config= to override.
+        heads = max(hidden // 64, 1)
+        logger.warning(
+            f"replace_transformer_layer: num_attention_heads not given; "
+            f"assuming head_dim=64 -> heads={heads}")
     ds_config = config or DeepSpeedTransformerConfig(
-        hidden_size=hidden or -1,
-        heads=heads or -1,
+        hidden_size=hidden if hidden is not None else -1,
+        heads=heads if heads is not None else -1,
         pre_layer_norm=preln,
         fp16=fp16,
         training=training)

@@ -166,6 +166,10 @@ class VariableSparsityConfig(SparsityConfig):
             raise NotImplementedError(
                 "only unidirectional or bidirectional attention is "
                 "supported")
+        if attention != "bidirectional" and horizontal_global_attention:
+            raise ValueError(
+                "horizontal global attention requires bidirectional "
+                "attention (full global rows attend to future blocks)")
         self.attention = attention
         self.horizontal_global_attention = horizontal_global_attention
 

@@ -31,6 +31,11 @@ NEG_INF = -1e30
 # over 128/128 and ~1.2x over the dense XLA path at T=2048); VMEM use at
 # d=128 is ~2.5 MB of the 16 MB budget.
 _DEFAULT_BLOCK = 512
+# Heads processed per grid step.  At short T the grid is overhead-bound
+# (each step's matmuls are microseconds), so batching heads into one
+# step cuts the iteration count G-fold; VMEM cost is G * block_q *
+# block_k fp32 for the score tile.
+_DEFAULT_HEAD_GROUP = 4
 
 
 def _on_tpu():
@@ -100,39 +105,51 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(visible)
     def _():
-        q = q_ref[0]                              # [bq, d] native dtype
-        k = k_ref[0]                              # [bk, d]
+        q = q_ref[...]                            # [G, bq, d] native dtype
+        k = k_ref[...]                            # [G, bk, d]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale   # [bq, bk]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale  # [G, bq, bk]
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
 
-        m_prev = m_scr[:, :1]                      # [bq, 1]
-        l_prev = l_scr[:, :1]
+        m_prev = m_scr[:, :, :1]                   # [G, bq, 1]
+        l_prev = l_scr[:, :, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # [bq, bk]
-        alpha = jnp.exp(m_prev - m_new)            # [bq, 1]
+        p = jnp.exp(s - m_new)                     # [G, bq, bk]
+        alpha = jnp.exp(m_prev - m_new)            # [G, bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0]                               # [bk, d]
+        v = v_ref[...]                             # [G, bk, d]
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)    # [bq, d]
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:, :1] = m_new
-        l_scr[:, :1] = l_new
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)    # [G, bq, d]
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[:, :, :1] = m_new
+        l_scr[:, :, :1] = l_new
 
     @pl.when(ki == nk - 1)
     def _():
-        l = l_scr[:, :1]
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+        l = l_scr[:, :, :1]
+        o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
+
+
+def _head_group(bh, block_q, block_k, d, tile_budget=4 * 1024 * 1024):
+    """Largest head-group G (≤ default) dividing B·H, with the fp32 score
+    tile capped to `tile_budget` bytes of VMEM (the backward kernels keep
+    ~4 score-sized tiles live, so they pass a smaller budget)."""
+    g = _DEFAULT_HEAD_GROUP
+    cap = max(1, tile_budget // (block_q * block_k * 4))
+    g = min(g, cap)
+    while bh % g:
+        g -= 1
+    return max(g, 1)
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
@@ -143,8 +160,9 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
     qt, kt, vt = to_bht(q), to_bht(k), to_bht(v)
 
+    g = _head_group(bh, block_q, block_k, d)
     nq, nk = t // block_q, t // block_k
-    grid = (bh, nq, nk)
+    grid = (bh // g, nq, nk)
     kernel = functools.partial(_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block_q=block_q,
                                block_k=block_k)
@@ -152,22 +170,22 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, 128), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, 128), jnp.float32),
+            pltpu.VMEM((g, block_q, d), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt)
@@ -186,8 +204,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == 0)
     def _():
-        dk_scr[:] = jnp.zeros_like(dk_scr)
-        dv_scr[:] = jnp.zeros_like(dv_scr)
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
     visible = True
     if causal:
@@ -195,42 +213,42 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _():
-        q = q_ref[0]                               # [bq, d] native dtype
-        k = k_ref[0]                               # [bk, d]
-        v = v_ref[0]
-        do = do_ref[0]                             # [bq, d]
-        lse = lse_ref[0]                           # [bq, 1]
-        delta = delta_ref[0]                       # [bq, 1]
+        q = q_ref[...]                             # [G, bq, d] native dtype
+        k = k_ref[...]                             # [G, bk, d]
+        v = v_ref[...]
+        do = do_ref[...]                           # [G, bq, d]
+        lse = lse_ref[...]                         # [G, bq, 1]
+        delta = delta_ref[...]                     # [G, bq, 1]
 
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
+        p = jnp.exp(s - lse)                       # [G, bq, bk]
 
         # dV += Pᵀ dO
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         # dP = dO Vᵀ ; dS = P ⊙ (dP − δ) · scale
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         # dK += dSᵀ Q
-        dk_scr[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
     @pl.when(qi == nq - 1)
     def _():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dk_ref[...] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -241,7 +259,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(ki == 0)
     def _():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
     visible = True
     if causal:
@@ -249,35 +267,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        q = q_ref[...]                             # [G, bq, d]
+        k = k_ref[...]                             # [G, bk, d]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
 
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         # dQ += dS K
-        dq_scr[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == nk - 1)
     def _():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
@@ -298,32 +316,33 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
                     axis=-1, keepdims=True)        # [bh, t, 1]
 
     nq, nk = t // block_q, t // block_k
+    g = _head_group(bh, block_q, block_k, d, tile_budget=2 * 1024 * 1024)
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
         block_q=block_q, block_k=block_k)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, nk, nq),
+        grid=(bh // g, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda bhi, ki, qi: (bhi, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), k.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, d), jnp.float32),
-            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((g, block_k, d), jnp.float32),
+            pltpu.VMEM((g, block_k, d), jnp.float32),
         ],
         interpret=interpret,
     )(qt, kt, vt, dot_, lse, delta)
@@ -333,19 +352,19 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
         block_q=block_q, block_k=block_k)
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, nq, nk),
+        grid=(bh // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
+            pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda bhi, qi, ki: (bhi, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d),
+        out_specs=pl.BlockSpec((g, block_q, d),
                                lambda bhi, qi, ki: (bhi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot_, lse, delta)
 

@@ -1,0 +1,316 @@
+"""User-facing activation checkpointing.
+
+TPU-native rebuild of the reference subsystem
+(`deepspeed/runtime/activation_checkpointing/checkpointing.py:362,666,747`):
+`checkpoint(fn, *args)` reruns the wrapped computation during the
+backward pass instead of saving its intermediates, and `configure()`
+applies the JSON `activation_checkpointing` block to every subsequent
+`checkpoint()` call.
+
+Reference behaviour → JAX mapping:
+
+* `CheckpointFunction` save/recompute (`:362-663`) → `jax.checkpoint`.
+  RNG restoration (`:148-263`) is free: the recompute replays the same
+  traced program with the same PRNG keys, bit-for-bit.
+* `partition_activations` (`:282-312`, all-gather regather in backward)
+  → the saved residuals (the checkpointed fn's inputs) carry a
+  `with_sharding_constraint` over the `model` mesh axis; XLA inserts
+  the backward all-gather exactly where `get_full_inputs` did.
+* `cpu_checkpointing` (`PA_TO_CPU`, `:418-437`) → saved residuals are
+  staged in `pinned_host` memory via device_put memory kinds; the
+  backward recompute fetches them back.
+* `contiguous_memory_optimization` / `number_checkpoints` /
+  `synchronize_checkpoint_boundary` → accepted no-ops (XLA owns buffer
+  packing and stream ordering); kept so configs parse identically.
+
+A `checkpoint_policy` escape hatch (TPU extension) selects any
+`jax.checkpoint_policies` entry by name for selective rematerialisation.
+"""
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.mesh import MODEL_AXIS
+from deepspeed_tpu.utils.logging import logger
+
+# ----------------------------------------------------------------------
+# module state (mirrors the reference's globals, checkpointing.py:40-56)
+# ----------------------------------------------------------------------
+PARTITION_ACTIVATIONS = False
+CPU_CHECKPOINTING = False
+CONTIGUOUS_CHECKPOINTING = False
+SYNCHRONIZE = False
+PROFILE_TIME = False
+num_layers = None
+
+_mesh = None
+_policy_name = None
+_configured = False
+_host_offload_ok = None  # lazily probed
+
+
+def is_configured():
+    return _configured
+
+
+def reset():
+    """Reference parity (`checkpointing.py:691`): frees contiguous
+    buffers between eval forwards.  XLA owns buffer lifetime, so this
+    is a no-op."""
+
+
+def set_num_layers(nlayers):
+    global num_layers
+    num_layers = nlayers
+
+
+def partition_activations_in_checkpoint(partition_activation):
+    global PARTITION_ACTIVATIONS
+    PARTITION_ACTIVATIONS = partition_activation
+
+
+def _configure_defaults():
+    global PARTITION_ACTIVATIONS, CPU_CHECKPOINTING, \
+        CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, num_layers, \
+        _configured
+    PARTITION_ACTIVATIONS = False
+    CPU_CHECKPOINTING = False
+    CONTIGUOUS_CHECKPOINTING = False
+    SYNCHRONIZE = False
+    PROFILE_TIME = False
+    num_layers = None
+    _configured = True
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              mesh=None, checkpoint_policy=None):
+    """Configure activation checkpointing (ref `checkpointing.py:747`).
+
+    `deepspeed_config` may be a parsed `DeepSpeedConfig`, a dict, or a
+    JSON path; explicit kwargs override its values.  `mesh` supplies the
+    device mesh whose `model` axis `partition_activations` shards over
+    (the reference gets this from `mpu_`; a Mesh is the TPU equivalent).
+    """
+    global PARTITION_ACTIVATIONS, CPU_CHECKPOINTING, \
+        CONTIGUOUS_CHECKPOINTING, SYNCHRONIZE, PROFILE_TIME, num_layers, \
+        _mesh, _policy_name, _configured
+
+    _configure_defaults()
+    if deepspeed_config is not None:
+        cfg = deepspeed_config
+        if isinstance(cfg, (str, dict)):
+            from deepspeed_tpu.runtime.config import DeepSpeedConfig
+            cfg = DeepSpeedConfig(cfg)
+        ac = cfg.activation_checkpointing_config
+        PARTITION_ACTIVATIONS = bool(ac.partition_activations)
+        CPU_CHECKPOINTING = bool(ac.cpu_checkpointing)
+        CONTIGUOUS_CHECKPOINTING = bool(ac.contiguous_memory_optimization)
+        SYNCHRONIZE = bool(ac.synchronize_checkpoint_boundary)
+        PROFILE_TIME = bool(ac.profile)
+        num_layers = ac.number_checkpoints
+
+    if partition_activations is not None:
+        PARTITION_ACTIVATIONS = partition_activations
+    if contiguous_checkpointing is not None:
+        CONTIGUOUS_CHECKPOINTING = contiguous_checkpointing
+    if num_checkpoints is not None:
+        num_layers = num_checkpoints
+    if checkpoint_in_cpu is not None:
+        CPU_CHECKPOINTING = checkpoint_in_cpu
+    if synchronize is not None:
+        SYNCHRONIZE = synchronize
+    if profile is not None:
+        PROFILE_TIME = profile
+    if mesh is not None:
+        _mesh = mesh
+    elif mpu_ is not None and hasattr(mpu_, "mesh"):
+        _mesh = mpu_.mesh
+    _policy_name = checkpoint_policy
+    _configured = True
+
+
+def _model_par(mesh):
+    try:
+        return int(mesh.shape[MODEL_AXIS])
+    except (KeyError, TypeError):
+        return 1
+
+
+def _partition_spec(x, mesh):
+    """Shard the last dim divisible by the model-axis size (the
+    reference flattens and splits evenly, `checkpointing.py:266-281`;
+    sharding one dim is the XLA-friendly equivalent).  The last dim is
+    preferred because the leading dim is usually the batch dim, already
+    sharded over `data` — re-sharding it over `model` would add an
+    all-to-all and *replicate* over data, the opposite of the goal."""
+    n = _model_par(mesh)
+    spec = [None] * x.ndim
+    for i in range(x.ndim - 1, -1, -1):
+        d = x.shape[i]
+        if d % n == 0 and d >= n:
+            spec[i] = MODEL_AXIS
+            break
+    return PartitionSpec(*spec)
+
+
+def _host_offload_supported():
+    global _host_offload_ok
+    if _host_offload_ok is None:
+        try:
+            dev = jax.devices()[0]
+            x = jnp.zeros((8,), jnp.float32)
+
+            @jax.jit
+            def put_host(v):
+                return jax.device_put(
+                    v, jax.sharding.SingleDeviceSharding(
+                        dev, memory_kind="pinned_host"))
+            jax.device_get(put_host(x))
+            _host_offload_ok = True
+        except Exception as e:  # backend without host memory space
+            logger.warning(
+                f"cpu_checkpointing requested but the backend does not "
+                f"support pinned_host memory ({type(e).__name__}); "
+                "falling back to on-device checkpointing")
+            _host_offload_ok = False
+    return _host_offload_ok
+
+
+def _is_array(x):
+    return isinstance(x, jax.Array) or hasattr(x, "dtype") and \
+        hasattr(x, "shape")
+
+
+def checkpoint(function, *args):
+    """Checkpoint a function (ref `checkpointing.py:666`): its
+    intermediates are recomputed, not saved, in the backward pass.
+    Returns `function(*args)`."""
+    policy = None
+    if _policy_name is not None:
+        policy = getattr(jax.checkpoint_policies, _policy_name)
+
+    partition = PARTITION_ACTIVATIONS and _mesh is not None and \
+        _model_par(_mesh) > 1
+    offload = CPU_CHECKPOINTING and _host_offload_supported()
+
+    if PROFILE_TIME:
+        inner = lambda *a: jax.named_scope("ds_checkpoint")(function)(*a)  # noqa: E731
+    else:
+        inner = function
+
+    if not partition and not offload:
+        return jax.checkpoint(inner, policy=policy)(*args)
+
+    mesh = _mesh
+
+    def _kinded_sharding(x, kind):
+        if mesh is not None:
+            spec = _partition_spec(x, mesh) if partition else \
+                PartitionSpec(*([None] * x.ndim))
+            return NamedSharding(mesh, spec, memory_kind=kind)
+        # no mesh configured (plain single-device parity usage)
+        return jax.sharding.SingleDeviceSharding(
+            jax.devices()[0], memory_kind=kind)
+
+    def stage(x):
+        """Transform each saved input: shard over the model axis and/or
+        park it in host memory until the backward recompute."""
+        if not _is_array(x) or x.ndim == 0:
+            return x
+        if partition:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _partition_spec(x, mesh)))
+        if offload:
+            x = jax.device_put(x, _kinded_sharding(x, "pinned_host"))
+        return x
+
+    def unstage(x):
+        if not _is_array(x) or x.ndim == 0:
+            return x
+        if offload:
+            x = jax.device_put(x, _kinded_sharding(x, "device"))
+        # partitioned activations are re-gathered by XLA wherever the
+        # recompute needs them replicated (ref get_full_inputs,
+        # checkpointing.py:282-312)
+        return x
+
+    staged = jax.tree_util.tree_map(stage, args)
+
+    def run(*staged_args):
+        live = jax.tree_util.tree_map(unstage, staged_args)
+        return inner(*live)
+
+    # jax.checkpoint saves only `run`'s inputs — i.e. the staged
+    # (sharded / host-resident) tensors — as residuals.
+    return jax.checkpoint(run, policy=policy)(*staged)
+
+
+# ----------------------------------------------------------------------
+# RNG stream tracker (API parity with CudaRNGStatesTracker,
+# ref checkpointing.py:148-263)
+# ----------------------------------------------------------------------
+class RNGStatesTracker:
+    """Named PRNG streams.  The reference forks/restores CUDA RNG state
+    so dropout is reproducible across recompute and distinct across
+    model-parallel ranks; in JAX recompute-reproducibility is automatic,
+    so this tracker only manages the named streams."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    @contextlib.contextmanager
+    def fork(self, name="model-parallel-rng"):
+        """Yields the stream's current key and advances the stream."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        key, nxt = jax.random.split(self.states_[name])
+        try:
+            yield key
+        finally:
+            self.states_[name] = nxt
+
+
+_RNG_TRACKER = RNGStatesTracker()
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+def get_rng_tracker():
+    return _RNG_TRACKER
+
+
+def model_parallel_manual_seed(seed, model_parallel_rank=0):
+    """Seed the default + model-parallel streams (ref
+    `model_parallel_cuda_manual_seed`, checkpointing.py:224-263): the
+    model-parallel stream differs per rank, the default stream does not.
+    Under SPMD pass `jax.lax.axis_index(MODEL_AXIS)`-derived ranks
+    inside shard_map, or a per-process rank outside."""
+    _RNG_TRACKER.reset()
+    mp_seed = seed + 2718 + int(model_parallel_rank)
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, mp_seed)
+    return jax.random.PRNGKey(seed)
+
+
+# torch-API aliases (what reference user code imports)
+get_cuda_rng_tracker = get_rng_tracker
+model_parallel_cuda_manual_seed = model_parallel_manual_seed
+CudaRNGStatesTracker = RNGStatesTracker

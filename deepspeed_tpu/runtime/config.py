@@ -41,9 +41,11 @@ def get_fp16_enabled(param_dict):
 
 
 def get_bfloat16_enabled(param_dict):
-    if C.BFLOAT16 in param_dict:
-        return get_scalar_param(param_dict[C.BFLOAT16], C.BFLOAT16_ENABLED,
-                                C.BFLOAT16_ENABLED_DEFAULT)
+    # Accept both the canonical "bf16" key and the "bfloat16" spelling.
+    for key in (C.BFLOAT16, C.BFLOAT16_ALIAS):
+        if key in param_dict:
+            return get_scalar_param(param_dict[key], C.BFLOAT16_ENABLED,
+                                    C.BFLOAT16_ENABLED_DEFAULT)
     return False
 
 

@@ -77,6 +77,7 @@ FP16_MIN_LOSS_SCALE_DEFAULT = 1
 # TPU-native extension: bfloat16 block (the natural TPU dtype; no loss
 # scaling needed). Accepted as {"bf16": {"enabled": true}}.
 BFLOAT16 = "bf16"
+BFLOAT16_ALIAS = "bfloat16"
 BFLOAT16_ENABLED = "enabled"
 BFLOAT16_ENABLED_DEFAULT = False
 

@@ -191,6 +191,17 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 "prescale_gradients/gradient_predivide_factor are no-ops: "
                 "gradients accumulate in fp32 under SPMD (exact averaging)")
 
+        # ---- activation checkpointing (ref engine wires the JSON block
+        # into deepspeed.checkpointing via configure, checkpointing.py:747)
+        ac = self._config.activation_checkpointing_config
+        if any([ac.partition_activations, ac.cpu_checkpointing,
+                ac.contiguous_memory_optimization,
+                ac.synchronize_checkpoint_boundary, ac.profile]):
+            from deepspeed_tpu.runtime.activation_checkpointing import \
+                checkpointing as ds_checkpointing
+            ds_checkpointing.configure(
+                mpu, deepspeed_config=self._config, mesh=self.mesh)
+
         # ---- progressive layer drop ----
         self.progressive_layer_drop = None
         if self.pld_enabled():
@@ -595,8 +606,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         else:
             scale = make_static_loss_scale_state(1.0)
 
-        acc = jax.device_put(_zeros_like_f32(params_f32),
-                             self._acc_shardings)
+        # With no gradient accumulation the persistent fp32 accumulator
+        # is pure overhead (equal in size to the master weights); grads
+        # flow straight from the microbatch into the update instead.
+        if self._jit_gas() == 1:
+            acc = ()
+        else:
+            acc = jax.device_put(_zeros_like_f32(params_f32),
+                                 self._acc_shardings)
 
         self.state = EngineState(
             params=params, master=master, opt_state=opt_state, scale=scale,
@@ -635,11 +652,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             grads, self._acc_shardings)
         return raw_loss, grads
 
-    def _unscale_clip_and_update(self, state: EngineState, lr):
-        """Tail of the step: unscale, overflow vote, clip, cond-update."""
+    def _unscale_clip_and_update(self, state: EngineState, lr,
+                                 grads=None):
+        """Tail of the step: unscale, overflow vote, clip, cond-update.
+        `grads` (gas=1 fast path) bypasses the persistent accumulator."""
         scale = state.scale.loss_scale
         grads = jax.tree_util.tree_map(
-            lambda g: g / scale, state.acc_grads)
+            lambda g: g / scale,
+            grads if grads is not None else state.acc_grads)
         grad_norm = _global_norm(grads)
         if self.fp16_mode:
             overflow = ~jnp.isfinite(grad_norm)
@@ -687,10 +707,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             delayed_shift=dyn_args.get(DELAYED_SHIFT, 2),
             dynamic=self.dynamic_loss_scale_enabled)
 
+        if self._jit_gas() == 1 and not self._offload_enabled():
+            new_acc = ()
+        else:
+            new_acc = _zeros_like_f32(state.acc_grads)
         new_state = EngineState(
             params=new_params, master=new_master, opt_state=new_opt,
             scale=new_scale,
-            acc_grads=_zeros_like_f32(state.acc_grads),
+            acc_grads=new_acc,
             skipped=state.skipped + overflow.astype(jnp.int32),
             global_steps=state.global_steps +
             (1 - overflow.astype(jnp.int32)))
@@ -755,6 +779,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         def fused_train_step(state, stacked_batch, rng, lr, keep_prob):
             """scan over gas microbatches then update; one compile."""
+            if gas == 1:
+                # no accumulator: grads flow straight into the update
+                mb = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
+                raw_loss, grads = self._micro_grad(
+                    state.params, mb, rng, state.scale.loss_scale,
+                    keep_prob)
+                new_state, overflow, grad_norm = \
+                    self._unscale_clip_and_update(state, lr, grads=grads)
+                return new_state, raw_loss, overflow, grad_norm
+
             def body(carry, mb):
                 acc, i = carry
                 mb_rng = jax.random.fold_in(rng, i)
@@ -863,9 +897,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).start()
             self.timers(BACKWARD_GLOBAL_TIMER).start()
-        self.state = self.state._replace(
-            acc_grads=self._accum_jit(self.state.acc_grads,
-                                      self._pending_grads))
+        if not jax.tree_util.tree_leaves(self.state.acc_grads):
+            # gas=1 fast path keeps no persistent accumulator; the first
+            # (only) microbatch's grads stand in directly
+            acc = self._pending_grads
+        else:
+            acc = self._accum_jit(self.state.acc_grads,
+                                  self._pending_grads)
+        self.state = self.state._replace(acc_grads=acc)
         self._pending_grads = None
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).stop()
@@ -1099,15 +1138,26 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         params_f32 = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, jnp.float32), sd["module"])
-        if self.mixed_precision:
-            master = jax.device_put(params_f32, self._master_shardings)
+        # Under ZeRO-Offload the fp32 master lives in pinned host memory
+        # (state.master is None); rebuilding a device master here would
+        # defeat offload and risk OOM (mirrors _init_state).
+        if self.mixed_precision or self._offload_enabled():
             params = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(
                     jnp.asarray(x, self.compute_dtype), s),
                 params_f32, self._param_shardings)
+            master = None if self._offload_enabled() else \
+                jax.device_put(params_f32, self._master_shardings)
         else:
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
+
+        if self._offload_enabled():
+            # keep host masters in sync with the restored weights even
+            # when optimizer state isn't being loaded
+            from jax.flatten_util import ravel_pytree
+            flat, _ = ravel_pytree(params_f32)
+            self._host_master[:] = np.asarray(jax.device_get(flat))
 
         opt_state = self.state.opt_state
         scale = self.state.scale
@@ -1125,11 +1175,8 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 # from the saved fp32 module weights; moments restart
                 logger.warning(
                     "checkpoint has no host-offload optimizer state "
-                    "(saved without cpu_offload?); restoring masters "
+                    "(saved without cpu_offload?); masters restored "
                     "from module weights, Adam moments reset")
-                from jax.flatten_util import ravel_pytree
-                flat, _ = ravel_pytree(params_f32)
-                self._host_master[:] = np.asarray(jax.device_get(flat))
         elif load_optimizer_states and optim_sd is not None:
             opt_state = jax.tree_util.tree_map(
                 lambda cur, saved: jax.device_put(
@@ -1138,10 +1185,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             scale = LossScaleState(*[jnp.asarray(x)
                                      for x in optim_sd["scale"]])
 
+        if self._jit_gas() == 1 and not self._offload_enabled():
+            acc_restored = ()
+        else:
+            acc_restored = jax.device_put(_zeros_like_f32(params_f32),
+                                          self._acc_shardings)
         self.state = EngineState(
             params=params, master=master, opt_state=opt_state, scale=scale,
-            acc_grads=jax.device_put(_zeros_like_f32(params_f32),
-                                     self._acc_shardings),
+            acc_grads=acc_restored,
             skipped=jnp.asarray(sd.get("skipped_steps", 0), jnp.int32),
             global_steps=jnp.asarray(
                 sd.get("global_steps", 0) - sd.get("skipped_steps", 0),

@@ -1,0 +1,188 @@
+"""Activation-checkpointing subsystem tests.
+
+Mirrors the reference's `test_activation_checkpointing.py` intent: the
+checkpointed computation must be numerically identical to the plain one
+under every config combination, and the config flags must actually
+change the compiled program (recompute flops / saved-residual sharding /
+host placement).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+from deepspeed_tpu.runtime.mesh import build_mesh
+
+
+@pytest.fixture(autouse=True)
+def _reset_config():
+    yield
+    ck._configure_defaults()
+    ck._mesh = None
+    ck._policy_name = None
+
+
+def _mlp(params, x):
+    h = jnp.tanh(x @ params["w1"])
+    h = jnp.tanh(h @ params["w2"])
+    return h @ params["w3"]
+
+
+def _make(n=64):
+    rng = np.random.default_rng(0)
+    params = {
+        "w1": jnp.asarray(rng.normal(size=(n, 4 * n)) * 0.05, jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(4 * n, 4 * n)) * 0.05,
+                          jnp.float32),
+        "w3": jnp.asarray(rng.normal(size=(4 * n, n)) * 0.05, jnp.float32),
+    }
+    x = jnp.asarray(rng.normal(size=(8, n)), jnp.float32)
+    return params, x
+
+
+def _loss(params, x, use_ckpt):
+    def blk(p, h):
+        return _mlp(p, h)
+    if use_ckpt:
+        out = ck.checkpoint(blk, params, x)
+    else:
+        out = blk(params, x)
+    return jnp.sum(out ** 2)
+
+
+@pytest.mark.parametrize("flags", [
+    {},
+    {"partition_activations": True},
+    {"cpu_checkpointing": True},
+    {"partition_activations": True, "cpu_checkpointing": True},
+    {"contiguous_memory_optimization": True,
+     "synchronize_checkpoint_boundary": True},
+])
+def test_checkpoint_numerics_match_dense(flags):
+    mesh = build_mesh({"pipe": 1, "data": 1, "model": 8})
+    ck.configure(None, deepspeed_config={
+        "train_micro_batch_size_per_gpu": 1,
+        "activation_checkpointing": flags}, mesh=mesh)
+    params, x = _make()
+
+    g_ref = jax.grad(lambda p: _loss(p, x, False))(params)
+    g_ck = jax.grad(lambda p: _loss(p, x, True))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_ref[k]),
+                                   np.asarray(g_ck[k]), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_checkpoint_recomputes_forward():
+    """Full remat re-runs the forward matmuls in the backward: the grad
+    jaxpr must contain more dot_generals than the unchendpointed one."""
+    ck.configure(None)
+    params, x = _make()
+
+    def jaxpr_str(use_ckpt):
+        return str(jax.make_jaxpr(jax.grad(
+            lambda p: _loss(p, x, use_ckpt)))(params))
+
+    plain, ck_str = jaxpr_str(False), jaxpr_str(True)
+    assert "remat" in ck_str and "remat" not in plain
+    assert ck_str.count("dot_general") >= plain.count("dot_general") + 2
+
+
+def test_policy_escape_hatch():
+    """checkpoint_policy selects a jax.checkpoint_policies entry."""
+    ck.configure(None, checkpoint_policy="everything_saveable")
+    params, x = _make()
+    g_pol = jax.grad(lambda p: _loss(p, x, True))(params)
+    g_ref = jax.grad(lambda p: _loss(p, x, False))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_pol[k]),
+                                   np.asarray(g_ref[k]), rtol=1e-5)
+
+
+def test_partition_activations_shards_saved_inputs():
+    """With partition_activations the staged residuals are sharded over
+    the model axis: the compiled backward regathers them (the reference
+    all-gathers in get_full_inputs, checkpointing.py:282-312)."""
+    mesh = build_mesh({"pipe": 1, "data": 1, "model": 8})
+    ck.configure(None, partition_activations=True, mesh=mesh)
+    params, x = _make()
+
+    spec = ck._partition_spec(x, mesh)
+    # last divisible dim preferred (leading dim is usually the already-
+    # data-sharded batch dim)
+    assert spec[1] == "model" and spec[0] is None
+
+    # end-to-end: grads still exact on the mesh
+    g_ref = jax.grad(lambda p: _loss(p, x, False))(params)
+    g_ck = jax.grad(lambda p: _loss(p, x, True))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_ref[k]),
+                                   np.asarray(g_ck[k]), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_cpu_checkpointing_without_mesh():
+    """Reference-parity configure() has no mesh argument; offload must
+    not crash when none was provided."""
+    ck.configure(None, checkpoint_in_cpu=True)
+    params, x = _make()
+    g_ref = jax.grad(lambda p: _loss(p, x, False))(params)
+    g_ck = jax.grad(lambda p: _loss(p, x, True))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_ref[k]),
+                                   np.asarray(g_ck[k]), rtol=1e-5,
+                                   atol=1e-5)
+
+
+def test_engine_configures_subsystem(mesh8):
+    """The JSON activation_checkpointing block reaches configure()
+    through the engine (ref engine wiring)."""
+    import flax.linen as nn
+    from deepspeed_tpu import initialize
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(x)
+
+    class Wrapper:
+        def __init__(self):
+            self.module = Tiny()
+
+        def init(self, rng, batch):
+            return self.module.init(rng, batch["x"])
+
+        def loss_fn(self, params, batch, rngs=None, deterministic=False):
+            out = self.module.apply(params, batch["x"])
+            return jnp.mean(out ** 2)
+
+    m = Wrapper()
+    params = m.init(jax.random.PRNGKey(0), {"x": np.zeros((8, 4),
+                                                          np.float32)})
+    initialize(model=m, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "activation_checkpointing": {"partition_activations": True},
+    }, mesh=mesh8)
+    assert ck.is_configured()
+    assert ck.PARTITION_ACTIVATIONS
+
+
+def test_rng_tracker_streams():
+    key = ck.model_parallel_manual_seed(1234, model_parallel_rank=0)
+    assert key is not None
+    tracker = ck.get_rng_tracker()
+    with tracker.fork() as k1:
+        pass
+    with tracker.fork() as k2:
+        pass
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))
+    # distinct ranks get distinct model-parallel streams
+    ck.model_parallel_manual_seed(1234, model_parallel_rank=1)
+    with ck.get_rng_tracker().fork() as k3:
+        pass
+    assert not np.array_equal(np.asarray(k1), np.asarray(k3))
+    with pytest.raises(Exception):
+        tracker.fork("missing").__enter__()
