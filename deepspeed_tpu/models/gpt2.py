@@ -320,6 +320,15 @@ class GPT2ForCausalLM:
     def apply(self, params, input_ids, deterministic=True):
         return self.module.apply({"params": params}, input_ids, deterministic)
 
+    def sparse_grad_paths(self):
+        """Param-path substrings whose grads are row-sparse, consumed by
+        the engine's CSR gradient path (ref `engine.py:1190-1246`).
+        Empty for GPT-2: the tied LM head makes the wte gradient DENSE
+        (every vocab row receives softmax-normalizer gradient), so CSR
+        compression would truncate it.  Models with pure-gather
+        embeddings (untied heads) should return their embedding paths."""
+        return ()
+
     # -- tensor parallel placement ---------------------------------------
     def tp_param_specs(self, params):
         """PartitionSpec tree: Megatron-style column/row sharding over the

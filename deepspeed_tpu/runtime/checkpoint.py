@@ -1,79 +1,342 @@
-"""Checkpoint file I/O.
+"""Sharded checkpoint file I/O (no pickle, no full-state host gather).
 
-Preserves the reference's on-disk layout (ref `engine.py:1255-1273`):
+Preserves the reference's on-disk layout (ref `engine.py:1255-1273`,
+`engine.py:1522-1531`):
 
-    <save_dir>/<tag>/mp_rank_00_model_states.pt
-    <save_dir>/<tag>/zero_pp_rank_0_mp_rank_00optim_states.pt
+    <save_dir>/<tag>/mp_rank_00_model_states.npz (+ .json manifest)
+    <save_dir>/<tag>/zero_pp_rank_{k}_mp_rank_00optim_states.npz (+ .json)
+    <save_dir>/<tag>/zero_pp_rank_{k}_mp_rank_00model_states.npz (+ .json)
     <save_dir>/latest                      (pointer file)
 
-with one deliberate upgrade: state is always saved as *full* (unpartitioned)
-arrays, so every checkpoint is an "elastic checkpoint" — loading onto a
-different mesh/world size just re-applies the current sharding
-(`jax.device_put`), subsuming the reference's elastic-vs-rigid ZeRO-1
-formats (`stage1.py:825-1024`) and its topology-change restrictions.
+Semantics, TPU-native:
 
-Serialization: numpy-pytree pickle (no torch). On multi-host, only process
-0 writes; arrays must be fully addressable or fully replicated (single-
-controller JAX guarantees this for state created through the engine).
+* **Per-shard files, no gather.** Every device that owns a primary
+  (replica_id == 0) shard of a sharded array contributes it to the
+  bucket file of that device's dp ordinal — the single-controller
+  equivalent of "every dp rank writes its own zero_pp_rank_N file with
+  barriers" (ref `engine.py:1522-1531`).  Each process writes only its
+  *addressable* shards, so a 13B multi-host save never materialises a
+  full array on any host (the round-1 `_fetch_to_host` allgather is
+  gone from the save path).
+* **Streamed npz + JSON manifests instead of pickle** — loadable
+  without arbitrary code execution, versioned (`format_version`).
+* **Elastic by construction.** Leaves are reassembled per-leaf on load
+  and re-placed under the *current* mesh sharding, so reloading onto a
+  different mesh/world size just works — subsuming the reference's
+  elastic-vs-rigid ZeRO-1 formats (`stage1.py:825-1024`).
+
+Legacy (round-1) pickle checkpoints are still readable, with a warning.
 """
 
+import json
 import os
 import pickle
+import re
 
 import jax
 import numpy as np
 
+FORMAT_VERSION = 2
 
-MODEL_STATES_FMT = "mp_rank_{:02d}_model_states.pt"
-OPTIM_STATES_FMT = "zero_pp_rank_{}_mp_rank_{:02d}optim_states.pt"
+MODEL_STATES_FMT = "mp_rank_{:02d}_model_states"
+OPTIM_SHARD_FMT = "zero_pp_rank_{}_mp_rank_{:02d}optim_states"
+MODEL_SHARD_FMT = "zero_pp_rank_{}_mp_rank_{:02d}model_states"
 LATEST_FILE = "latest"
 
-
-def _to_numpy(tree):
-    return jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x)),
-                                  tree)
+_SHARD_RE = re.compile(
+    r"zero_pp_rank_(\d+)_mp_rank_(\d+)(optim|model)_states\.npz$")
 
 
+# ----------------------------------------------------------------------
+# pytree <-> flat path/leaf maps
+# ----------------------------------------------------------------------
+def tree_to_entries(tree, prefix=""):
+    """[(path_string, leaf)] with jax.tree_util paths (stable across
+    save/load as long as the tree structure matches)."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(prefix + jax.tree_util.keystr(path), leaf)
+            for path, leaf in flat]
+
+
+def entries_to_tree(template, flat, prefix=""):
+    """Rebuild leaves of `template`'s structure from a {path: array}
+    map (missing keys raise KeyError with the offending path)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, _ in paths:
+        key = prefix + jax.tree_util.keystr(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint is missing entry {key!r}")
+        leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _is_array(x):
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def _dp_ordinal(sharding, device):
+    """Stable ordinal of `device` within the sharding's device set —
+    the dp-rank analog that names the bucket file."""
+    ids = sorted(d.id for d in sharding.device_set)
+    return ids.index(device.id)
+
+
+# ----------------------------------------------------------------------
+# save
+# ----------------------------------------------------------------------
 def _ckpt_dir(save_dir, tag):
     return os.path.join(save_dir, str(tag))
 
 
 def model_states_path(save_dir, tag, mp_rank=0):
     return os.path.join(_ckpt_dir(save_dir, tag),
-                        MODEL_STATES_FMT.format(mp_rank))
+                        MODEL_STATES_FMT.format(mp_rank) + ".npz")
 
 
-def optim_states_path(save_dir, tag, dp_rank=0, mp_rank=0):
-    return os.path.join(_ckpt_dir(save_dir, tag),
-                        OPTIM_STATES_FMT.format(dp_rank, mp_rank))
+def _split_shards(entries):
+    """Split entries into (replicated, sharded).  `replicated` leaves
+    are written once by process 0; `sharded` leaves contribute one
+    piece per primary shard to per-ordinal bucket files."""
+    replicated, sharded = [], []
+    for key, leaf in entries:
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding") and \
+                not leaf.sharding.is_fully_replicated:
+            sharded.append((key, leaf))
+        else:
+            replicated.append((key, leaf))
+    return replicated, sharded
+
+
+def _write_shard_buckets(ckpt_dir, fmt, sharded, mp_rank=0):
+    """Write each primary shard of each sharded leaf into the bucket
+    file of its owning device's dp ordinal.  Only addressable shards
+    are touched — multi-host safe, no cross-host traffic."""
+    buckets = {}       # ordinal -> {npz_name: np.ndarray}
+    bucket_meta = {}   # ordinal -> [entry meta]
+    for key, leaf in sharded:
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            ordinal = _dp_ordinal(leaf.sharding, shard.device)
+            name = f"s{len(bucket_meta.get(ordinal, []))}"
+            start = [0 if sl.start is None else int(sl.start)
+                     for sl in shard.index]
+            buckets.setdefault(ordinal, {})[name] = np.asarray(shard.data)
+            bucket_meta.setdefault(ordinal, []).append({
+                "name": name, "key": key, "start": start,
+                "global_shape": list(leaf.shape), "dtype": str(leaf.dtype),
+            })
+    for ordinal, arrays in buckets.items():
+        base = os.path.join(ckpt_dir, fmt.format(ordinal, mp_rank))
+        np.savez(base + ".npz", **arrays)
+        with open(base + ".json", "w") as f:
+            json.dump({"format_version": FORMAT_VERSION,
+                       "entries": bucket_meta[ordinal]}, f)
+
+
+def _json_safe(obj):
+    """Recursively convert checkpoint metadata to JSON-able values;
+    numpy scalars/arrays become lists (small metadata only)."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, (np.generic,)):
+        return obj.item()
+    if isinstance(obj, (np.ndarray, jax.Array)):
+        return {"__ndarray__": np.asarray(obj).tolist(),
+                "dtype": str(np.asarray(obj).dtype)}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    from deepspeed_tpu.utils.logging import logger
+    logger.warning(
+        f"checkpoint metadata value of type {type(obj).__name__} is not "
+        "JSON-serializable; storing its repr (round-trip lossy)")
+    return {"__unserializable__": repr(obj)}
+
+
+def _json_restore(obj):
+    if isinstance(obj, dict):
+        if "__ndarray__" in obj:
+            return np.asarray(obj["__ndarray__"],
+                              dtype=np.dtype(obj["dtype"]))
+        return {k: _json_restore(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_restore(v) for v in obj]
+    return obj
 
 
 def save_checkpoint_files(save_dir, tag, model_sd, optim_sd,
                           zero_enabled=False, mp_rank=0, dp_rank=0):
+    """Write a sharded checkpoint.
+
+    `model_sd` — dict with a "module" pytree of (possibly sharded) jax
+    arrays plus JSON-able metadata entries.  `optim_sd` — dict with an
+    "opt_state" pytree plus metadata; may be None.  All processes must
+    call this (each writes its own shards); process 0 writes manifests.
+    """
+    ckpt_dir = _ckpt_dir(save_dir, tag)
+    os.makedirs(ckpt_dir, exist_ok=True)
+
+    module = model_sd.get("module", {})
+    mod_entries = tree_to_entries(module, "module")
+    mod_repl, mod_sharded = _split_shards(mod_entries)
+    _write_shard_buckets(ckpt_dir, MODEL_SHARD_FMT, mod_sharded, mp_rank)
+
+    opt_repl, opt_sharded = [], []
+    opt_meta = {}
+    if optim_sd is not None:
+        opt_entries = []
+        for k, v in optim_sd.items():
+            if k == "opt_state":
+                opt_entries += tree_to_entries(v, "optim")
+            elif _is_array(v) or (isinstance(v, (tuple, list)) and
+                                  any(_is_array(x) for x in
+                                      jax.tree_util.tree_leaves(v))):
+                opt_entries += tree_to_entries(v, f"aux/{k}")
+            else:
+                opt_meta[k] = v
+        opt_repl, opt_sharded = _split_shards(opt_entries)
+        _write_shard_buckets(ckpt_dir, OPTIM_SHARD_FMT, opt_sharded,
+                             mp_rank)
+
     if jax.process_index() != 0:
         return
-    os.makedirs(_ckpt_dir(save_dir, tag), exist_ok=True)
-    with open(model_states_path(save_dir, tag, mp_rank), "wb") as f:
-        pickle.dump(_to_numpy(model_sd), f, protocol=pickle.HIGHEST_PROTOCOL)
-    if optim_sd is not None:
-        with open(optim_states_path(save_dir, tag, dp_rank, mp_rank),
-                  "wb") as f:
-            pickle.dump(_to_numpy(optim_sd), f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+
+    meta = {k: v for k, v in model_sd.items() if k != "module"}
+    main = {}
+    for key, leaf in mod_repl + opt_repl:
+        main[key] = np.asarray(jax.device_get(leaf))
+    base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
+    np.savez(base + ".npz", **main)
+    with open(base + ".json", "w") as f:
+        json.dump({
+            "format_version": FORMAT_VERSION,
+            "meta": _json_safe(meta),
+            "optim_meta": _json_safe(opt_meta),
+            "has_optim": optim_sd is not None,
+        }, f)
+
+
+# ----------------------------------------------------------------------
+# load
+# ----------------------------------------------------------------------
+def _assemble(flat, shard_entries):
+    """Reassemble sharded leaves on host, one leaf at a time (peak host
+    memory = one global leaf, not the whole tree)."""
+    by_key = {}
+    for npz, entry in shard_entries:
+        by_key.setdefault(entry["key"], []).append((npz, entry))
+    for key, pieces in by_key.items():
+        _, first = pieces[0]
+        out = np.zeros(first["global_shape"],
+                       dtype=np.dtype(first["dtype"]))
+        for npz, entry in pieces:
+            piece = npz[entry["name"]]
+            idx = tuple(slice(s, s + d) for s, d in
+                        zip(entry["start"], piece.shape))
+            out[idx] = piece
+        flat[key] = out
+    return flat
+
+
+def _load_legacy_pickle(load_dir, tag, mp_rank, dp_rank):
+    from deepspeed_tpu.utils.logging import logger
+    logger.warning(
+        "loading legacy (round-1) pickle checkpoint; resave to upgrade "
+        "to the sharded npz format")
+    legacy_model = os.path.join(
+        _ckpt_dir(load_dir, tag), f"mp_rank_{mp_rank:02d}_model_states.pt")
+    with open(legacy_model, "rb") as f:
+        model_sd = pickle.load(f)
+    optim_sd = None
+    legacy_opt = os.path.join(
+        _ckpt_dir(load_dir, tag),
+        f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}optim_states.pt")
+    if os.path.exists(legacy_opt):
+        with open(legacy_opt, "rb") as f:
+            optim_sd = pickle.load(f)
+    return model_sd, optim_sd, True
+
+
+def load_checkpoint_flat(load_dir, tag, mp_rank=0):
+    """Read a sharded checkpoint into ({path: np.array}, meta,
+    optim_meta, has_optim).  Paths are prefixed "module"/"optim"/"aux"."""
+    ckpt_dir = _ckpt_dir(load_dir, tag)
+    base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
+    with open(base + ".json") as f:
+        manifest = json.load(f)
+    flat = {}
+    with np.load(base + ".npz") as main:
+        for key in main.files:
+            flat[key] = main[key]
+
+    shard_entries = []
+    for fname in sorted(os.listdir(ckpt_dir)):
+        m = _SHARD_RE.match(fname)
+        if not m or int(m.group(2)) != mp_rank:
+            continue
+        npz = np.load(os.path.join(ckpt_dir, fname))
+        with open(os.path.join(
+                ckpt_dir, fname[:-len(".npz")] + ".json")) as f:
+            bucket = json.load(f)
+        for entry in bucket["entries"]:
+            shard_entries.append((npz, entry))
+    _assemble(flat, shard_entries)
+    return (flat, _json_restore(manifest.get("meta", {})),
+            _json_restore(manifest.get("optim_meta", {})),
+            manifest.get("has_optim", False))
 
 
 def load_checkpoint_files(load_dir, tag, zero_enabled=True, mp_rank=0,
-                          dp_rank=0):
-    with open(model_states_path(load_dir, tag, mp_rank), "rb") as f:
-        model_sd = pickle.load(f)
+                          dp_rank=0, module_template=None,
+                          opt_state_template=None, aux_templates=None):
+    """Engine-facing loader.  Returns (model_sd, optim_sd) shaped like
+    the save-side inputs: model_sd["module"] is a pytree when
+    `module_template` is given (otherwise the flat {path: array} map
+    under model_sd["module_flat"]); likewise optim_sd["opt_state"].
+    `zero_enabled` gates whether optimizer state is assembled at all."""
+    legacy_marker = os.path.join(
+        _ckpt_dir(load_dir, tag), f"mp_rank_{mp_rank:02d}_model_states.pt")
+    npz_marker = model_states_path(load_dir, tag, mp_rank)
+    if not os.path.exists(npz_marker) and os.path.exists(legacy_marker):
+        model_sd, optim_sd, _ = _load_legacy_pickle(load_dir, tag, mp_rank,
+                                                    dp_rank)
+        return model_sd, optim_sd
+
+    flat, meta, opt_meta, has_optim = load_checkpoint_flat(
+        load_dir, tag, mp_rank)
+
+    model_sd = dict(meta)
+    if module_template is not None:
+        model_sd["module"] = entries_to_tree(module_template, flat,
+                                             "module")
+    else:
+        model_sd["module_flat"] = {
+            k: v for k, v in flat.items() if k.startswith("module")}
+
     optim_sd = None
-    opt_path = optim_states_path(load_dir, tag, dp_rank, mp_rank)
-    if os.path.exists(opt_path):
-        with open(opt_path, "rb") as f:
-            optim_sd = pickle.load(f)
+    if has_optim and zero_enabled:
+        optim_sd = dict(opt_meta)
+        if opt_state_template is not None:
+            try:
+                optim_sd["opt_state"] = entries_to_tree(
+                    opt_state_template, flat, "optim")
+            except KeyError:
+                optim_sd["opt_state"] = None
+        for name, template in (aux_templates or {}).items():
+            try:
+                optim_sd[name] = entries_to_tree(template, flat,
+                                                 f"aux/{name}")
+            except KeyError:
+                pass
     return model_sd, optim_sd
 
 
+# ----------------------------------------------------------------------
+# latest tag + tag validation
+# ----------------------------------------------------------------------
 def write_latest_tag(save_dir, tag):
     os.makedirs(save_dir, exist_ok=True)
     with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
@@ -86,3 +349,25 @@ def read_latest_tag(load_dir):
         return None
     with open(path, "r") as f:
         return f.read().strip()
+
+
+def validate_checkpoint_tag(tag, fail_on_mismatch=False):
+    """Cross-process tag consistency vote (ref `engine.py:1448-1463`:
+    sha1 min/max allreduce).  Returns True when all processes agree."""
+    import hashlib
+    digest = np.frombuffer(hashlib.sha1(str(tag).encode()).digest(),
+                           dtype=np.uint8).astype(np.int32)
+    if jax.process_count() == 1:
+        return True
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(digest)
+    valid = bool((gathered == gathered[0]).all())
+    msg = (f"checkpoint tag '{tag}' is not consistent across all "
+           "processes; rank-unique tags break restores at different "
+           "world sizes")
+    if fail_on_mismatch:
+        assert valid, msg
+    elif not valid:
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(msg)
+    return valid

@@ -52,6 +52,7 @@ from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.runtime.checkpoint import (save_checkpoint_files,
                                               load_checkpoint_files,
                                               read_latest_tag,
+                                              validate_checkpoint_tag,
                                               write_latest_tag)
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -643,6 +644,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         return loss * (loss_scale / gas), loss
 
     def _micro_grad(self, params, batch, rng, loss_scale, keep_prob):
+        if self._use_shardmap_grads:
+            return self._micro_grad_shardmap(params, batch, rng,
+                                             loss_scale, keep_prob)
         grad_fn = jax.value_and_grad(self._scaled_loss_fn, has_aux=True)
         (_, raw_loss), grads = grad_fn(params, batch, rng, loss_scale,
                                        keep_prob)
@@ -650,6 +654,68 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             lambda g: g.astype(jnp.float32), grads)
         grads = jax.lax.with_sharding_constraint(
             grads, self._acc_shardings)
+        return raw_loss, grads
+
+    def _sparse_grad_paths(self):
+        if not self.sparse_gradients_enabled():
+            return ()
+        return tuple(getattr(self.module, "sparse_grad_paths",
+                             lambda: ())())
+
+    def _micro_grad_shardmap(self, params, batch, rng, loss_scale,
+                             keep_prob):
+        """Gradients via an explicit shard_map over the data axis, so
+        per-leaf collectives can diverge from dense psum: embedding
+        grads ride the CSR all-gather (ref `engine.py:1190-1246`) and
+        1-bit Adam's compressed allreduce gets a real axis to run over.
+        Only used at ZeRO stage 0 (params replicated), matching the
+        reference, whose CSR path lives in the non-ZeRO fallback
+        (`engine.py:836,1160`)."""
+        from jax.experimental.shard_map import shard_map
+        from deepspeed_tpu.runtime.csr_tensor import csr_mean_rows
+
+        sparse_paths = self._sparse_grad_paths()
+        mesh = self.mesh
+
+        kp_is_none = keep_prob is None
+
+        def per_shard(params, batch, rng, loss_scale, kp):
+            kp = None if kp_is_none else kp
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(DATA_AXIS))
+            grad_fn = jax.value_and_grad(self._scaled_loss_fn,
+                                         has_aux=True)
+            (_, raw_loss), grads = grad_fn(params, batch, rng,
+                                           loss_scale, kp)
+            tokens = int(np.prod(
+                jax.tree_util.tree_leaves(batch)[0].shape))
+
+            flat = jax.tree_util.tree_flatten_with_path(grads)
+            leaves = []
+            for path, g in flat[0]:
+                key = jax.tree_util.keystr(path)
+                g = g.astype(jnp.float32)
+                if any(p in key for p in sparse_paths) and g.ndim == 2:
+                    capacity = min(g.shape[0], tokens)
+                    g = csr_mean_rows(g, DATA_AXIS, capacity)
+                else:
+                    g = jax.lax.pmean(g, DATA_AXIS)
+                leaves.append(g)
+            grads = jax.tree_util.tree_unflatten(flat[1], leaves)
+            return jax.lax.pmean(raw_loss, DATA_AXIS), grads
+
+        P = PartitionSpec
+
+        def batch_spec(x):
+            return P(DATA_AXIS, *([None] * (x.ndim - 1)))
+
+        batch_specs = jax.tree_util.tree_map(batch_spec, batch)
+        kp_in = jnp.float32(0.0) if kp_is_none else keep_prob
+        raw_loss, grads = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(), batch_specs, P(), P(), P()),
+            out_specs=(P(), P()),
+            check_rep=False)(params, batch, rng, loss_scale, kp_in)
         return raw_loss, grads
 
     def _unscale_clip_and_update(self, state: EngineState, lr,
@@ -738,6 +804,25 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._master_pspecs_cached = jax.tree_util.tree_map(
             lambda s: s, self._master_shardings)
         self._param_pspecs_cached = self._param_shardings
+
+        # Explicit shard_map grads: needed when per-leaf DP collectives
+        # diverge from dense psum (CSR sparse embedding grads).  Gated
+        # to stage 0 with a pure data mesh — the same scope as the
+        # reference's buffered_allreduce_fallback CSR path.
+        self._use_shardmap_grads = (
+            self.zero_optimization_stage() == 0 and
+            not self._offload_enabled() and
+            bool(self._sparse_grad_paths()) and
+            self.mesh.shape[DATA_AXIS] > 1 and
+            self.mesh.shape[MODEL_AXIS] == 1 and
+            self.mesh.shape[PIPE_AXIS] == 1)
+        if self.sparse_gradients_enabled() and \
+                not self._use_shardmap_grads and \
+                self.mesh.shape[DATA_AXIS] > 1:
+            logger.warning(
+                "sparse_gradients requested but unavailable here "
+                "(needs zero stage 0, a pure-data mesh, and a model "
+                "exposing sparse_grad_paths()); using dense reduction")
 
         def micro_grad_fn(params, batch, rng, loss_scale, keep_prob):
             return self._micro_grad(params, batch, rng, loss_scale, keep_prob)
@@ -1096,8 +1181,23 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         save_latest=True):
         if tag is None:
             tag = f"global_step{self.global_steps}"
+        if self.checkpoint_tag_validation_enabled():
+            validate_checkpoint_tag(
+                tag, fail_on_mismatch=self.checkpoint_tag_validation_fail())
+        # PipelineModule-style models write one file per layer so the
+        # checkpoint reloads onto any stage partitioning
+        # (ref pipe/module.py:536-567)
+        per_layer = hasattr(self.module, "save_state_dict") and \
+            hasattr(self.module, "load_state_dir")
+        if per_layer and jax.process_index() == 0:
+            import os
+            self.module.save_state_dict(
+                os.path.join(save_dir, str(tag)), self.fp32_params)
+        # module/opt_state stay as (possibly sharded) jax arrays: the
+        # writer streams each process's addressable shards to its own
+        # zero_pp_rank files — no host gather (ref engine.py:1522-1531).
         sd = dict(
-            module=_fetch_to_host(self.fp32_params),
+            module={} if per_layer else self.fp32_params,
             global_steps=self.global_steps,
             skipped_steps=self.skipped_steps,
             micro_steps=self.micro_steps,
@@ -1108,7 +1208,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         )
         sd.update(client_state or {})
         optim_sd = dict(
-            opt_state=_fetch_to_host(self.state.opt_state),
+            opt_state=self.state.opt_state,
             scale=jax.device_get(self.state.scale),
             zero_stage=self.zero_optimization_stage(),
         )
@@ -1132,9 +1232,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 logger.warning(
                     f"Unable to find latest file at {load_dir}/latest")
                 return None, {}
+        aux_templates = {"scale": jax.device_get(self.state.scale)}
+        if self._offload_enabled():
+            aux_templates["host_master"] = self._host_master
+            aux_templates["host_adam"] = self._host_adam.state_dict()
+        per_layer = hasattr(self.module, "save_state_dict") and \
+            hasattr(self.module, "load_state_dir")
         sd, optim_sd = load_checkpoint_files(
-            load_dir, tag, zero_enabled=self.zero_optimization() and
-            load_optimizer_states)
+            load_dir, tag, zero_enabled=load_optimizer_states,
+            module_template=None if per_layer else self.state.params,
+            opt_state_template=self.state.opt_state,
+            aux_templates=aux_templates)
+        if per_layer and "module" not in sd:
+            import os
+            sd["module"] = self.module.load_state_dir(
+                os.path.join(load_dir, str(tag)), self.state.params)
 
         params_f32 = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, jnp.float32), sd["module"])
@@ -1207,9 +1319,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         client_state = {
             k: v for k, v in sd.items()
-            if k not in ("module", "global_steps", "skipped_steps",
-                         "micro_steps", "dp_world_size", "lr_scheduler",
-                         "rng")
+            if k not in ("module", "module_flat", "global_steps",
+                         "skipped_steps", "micro_steps", "dp_world_size",
+                         "lr_scheduler", "rng")
         }
         log_dist(f"loaded checkpoint {tag} from {load_dir}", ranks=[0])
         return f"{load_dir}/{tag}", client_state

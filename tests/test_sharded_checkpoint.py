@@ -1,0 +1,170 @@
+"""Sharded-checkpoint tests (VERDICT r1 #4).
+
+The reference writes per-dp-rank zero shard files with barriers
+(`engine.py:1522-1531`), per-layer pipeline files (`pipe/module.py:536-567`)
+and validates tags cross-rank (`engine.py:1448-1463`).  Here: per-shard
+npz bucket files (no pickle, no full-host gather on save), per-layer
+files, elastic reload onto a different mesh shape.
+"""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import initialize
+from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+from deepspeed_tpu.runtime.mesh import build_mesh
+
+
+def _make_engine(mesh, stage=2, lr=1e-3):
+    cfg = tiny_gpt2_config(n_layer=2, n_embd=64, n_head=4,
+                          n_positions=64, vocab_size=256)
+    model = GPT2ForCausalLM(cfg)
+    ids = np.random.default_rng(0).integers(
+        0, 256, (8, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})
+    engine, _, _, _ = initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "zero_optimization": {"stage": stage},
+                "optimizer": {"type": "Adam", "params": {"lr": lr}}},
+        mesh=mesh)
+    return engine, ids
+
+
+def _train(engine, ids, steps=3):
+    loss = None
+    for i in range(steps):
+        loss = engine.train_batch(
+            batch={"input_ids": ids[None]})
+    return float(jax.device_get(loss))
+
+
+def test_save_writes_shard_files_no_pickle(tmp_path):
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=2)
+    _train(engine, ids)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    d = str(tmp_path / "t1")
+    files = os.listdir(d)
+    # no pickle anywhere
+    assert not any(f.endswith(".pt") for f in files), files
+    # ZeRO-2: optimizer moments are data-sharded -> per-ordinal buckets
+    opt_shards = glob.glob(os.path.join(d, "zero_pp_rank_*optim*.npz"))
+    assert len(opt_shards) == 8, sorted(files)
+    # manifest is valid JSON with a format version
+    with open(os.path.join(d, "mp_rank_00_model_states.json")) as f:
+        manifest = json.load(f)
+    assert manifest["format_version"] >= 2
+
+
+def test_roundtrip_same_mesh(tmp_path):
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=2)
+    _train(engine, ids)
+    before = jax.device_get(engine.state.params)
+    m_before = jax.device_get(
+        jax.tree_util.tree_leaves(engine.state.opt_state))
+    engine.save_checkpoint(str(tmp_path), tag="rt")
+
+    engine2, _ = _make_engine(mesh, stage=2)
+    engine2.load_checkpoint(str(tmp_path), tag="rt")
+    after = jax.device_get(engine2.state.params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-6),
+        before, after)
+    m_after = jax.device_get(
+        jax.tree_util.tree_leaves(engine2.state.opt_state))
+    for a, b in zip(m_before, m_after):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_elastic_reload_different_mesh(tmp_path):
+    """Save on data=8, reload on data=4 x model=2 — the elastic
+    behaviour the reference only supports for ZeRO-1 dp resize
+    (`stage1.py:1048`)."""
+    mesh8 = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh8, stage=3)
+    _train(engine, ids)
+    loss_before = _train(engine, ids, steps=1)
+    engine.save_checkpoint(str(tmp_path), tag="elastic")
+
+    mesh42 = build_mesh({"pipe": 1, "data": 4, "model": 2})
+    engine2, _ = _make_engine(mesh42, stage=2)
+    engine2.load_checkpoint(str(tmp_path), tag="elastic")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(jax.device_get(a), np.float32),
+            np.asarray(jax.device_get(b), np.float32), rtol=1e-6),
+        jax.device_get(engine.state.params),
+        jax.device_get(engine2.state.params))
+    # training continues at the restored point
+    loss_after = _train(engine2, ids, steps=1)
+    assert abs(loss_after - loss_before) < 0.5
+
+
+def test_per_layer_pipeline_files(tmp_path):
+    """PipelineModule checkpoints write layer_NN files and reload onto
+    a different stage count (ref test_checkpointing.py:633)."""
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    class Dense(nn.Module):
+        feats: int = 16
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(self.feats)(x)
+
+    specs = [LayerSpec(Dense, 16) for _ in range(4)]
+    mod2 = PipelineModule(layers=specs, num_stages=2)
+    x = np.zeros((2, 16), np.float32)
+    params = mod2.init_params(jax.random.PRNGKey(0), x)
+
+    ckpt_dir = str(tmp_path / "layers")
+    mod2.save_state_dict(ckpt_dir, params)
+    files = sorted(os.listdir(ckpt_dir))
+    assert [f for f in files if f.startswith("layer_")] == [
+        f"layer_{i:02d}-model_states.npz" for i in range(4)]
+
+    # reload with a different partitioning (4 stages)
+    mod4 = PipelineModule(layers=specs, num_stages=4)
+    template = mod4.init_params(jax.random.PRNGKey(1), x)
+    restored = mod4.load_state_dir(ckpt_dir, template)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b)),
+        params, restored)
+
+
+def test_tag_validation_single_process():
+    from deepspeed_tpu.runtime.checkpoint import validate_checkpoint_tag
+    assert validate_checkpoint_tag("step5", fail_on_mismatch=True)
+
+
+def test_legacy_pickle_checkpoint_still_loads(tmp_path):
+    """Round-1 checkpoints (pickle .pt) remain readable."""
+    import pickle
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=0)
+    d = tmp_path / "old"
+    os.makedirs(d)
+    module = jax.device_get(engine.state.params)
+    sd = {"module": module, "global_steps": 7, "skipped_steps": 0,
+          "micro_steps": 7, "dp_world_size": 8, "lr_scheduler": None,
+          "rng": np.zeros(2, np.uint32)}
+    with open(d / "mp_rank_00_model_states.pt", "wb") as f:
+        pickle.dump(sd, f)
+    (tmp_path / "latest").write_text("old")
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None
+    assert engine.global_steps == 7
