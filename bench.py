@@ -1,14 +1,16 @@
 #!/usr/bin/env python
-"""Headline benchmark: GPT-2 training throughput on one TPU chip.
+"""Benchmarks on real TPU hardware across the BASELINE.json config list.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line whose headline is GPT-2 training throughput
+(tokens/s/chip, `vs_baseline` = achieved_MFU / 0.45 — the reference's
+north-star MFU for Megatron-GPT2 under ZeRO, BASELINE.md), with an
+`extra` dict carrying the other BASELINE configs:
 
-The reference's north star (BASELINE.json) is tokens/sec/chip + MFU for
-Megatron-GPT2; its published target is >=45% MFU for ZeRO-2+pipeline on
-v5p.  Here we run the flagship GPT-2 on however many chips are attached
-(one under the driver), fused jitted train step, bf16, and report
-tokens/sec/chip with `vs_baseline` = achieved_MFU / 0.45.
+  * BERT-large with the fused DeepSpeedTransformerLayer, seq 128 —
+    reference published 272 samples/s / 64 TFLOPS on 1x V100
+    (`docs/_tutorials/bert-pretraining.md:387`)
+  * 16k-context block-sparse attention vs dense flash attention —
+    reference claims up to 6.3x over dense (`docs/index.md:135`)
 """
 
 import json
@@ -39,12 +41,24 @@ def _peak_flops(device) -> float:
     return 0.0  # unknown (e.g. CPU) -> MFU reported as 0
 
 
-def main():
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
-
+def _run_engine(model, params, ds_config, make_batch, steps, warmup):
     from deepspeed_tpu import initialize
-    from deepspeed_tpu.models.gpt2 import (GPT2ForCausalLM, gpt2_config)
+    engine, _, _, _ = initialize(model=model, model_parameters=params,
+                                 config=ds_config)
+    for i in range(warmup):
+        loss = engine.train_batch(batch=make_batch(i))
+    # device_get forces a true sync; block_until_ready alone can return
+    # early through remote-device tunnels
+    float(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for i in range(steps):
+        loss = engine.train_batch(batch=make_batch(100 + i))
+    float(jax.device_get(loss))
+    return time.perf_counter() - t0
+
+
+def bench_gpt2(on_tpu):
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
 
     if on_tpu:
         # Tuned on v5e-1: batch 16 + selective remat (save weight-matmul
@@ -57,57 +71,129 @@ def main():
     cfg = gpt2_config(model_name, n_positions=seq, dropout=0.0, remat=True,
                       remat_policy="dots_with_no_batch_dims_saveable")
     model = GPT2ForCausalLM(cfg)
-
     rng = jax.random.PRNGKey(0)
-    example = {"input_ids": np.zeros((batch, seq), np.int32)}
-    params = model.init(rng, example)
-
-    ds_config = {
-        "train_micro_batch_size_per_gpu": batch,
-        "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 0},
-        "optimizer": {"type": "AdamW",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-    }
-    engine, _, _, _ = initialize(model=model, model_parameters=params,
-                                 config=ds_config)
+    params = model.init(rng, {"input_ids": np.zeros((batch, seq),
+                                                    np.int32)})
 
     def make_batch(i):
         ids = np.random.default_rng(i).integers(
             0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
         return {"input_ids": ids}
 
-    for i in range(warmup):
-        loss = engine.train_batch(batch=make_batch(i))
-    # device_get forces a true sync; block_until_ready alone can return
-    # early through remote-device tunnels
-    float(jax.device_get(loss))
+    dt = _run_engine(model, params, {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 0},
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+    }, make_batch, steps, warmup)
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss = engine.train_batch(batch=make_batch(100 + i))
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
-
-    n_chips = len(devices)
-    tokens_per_sec = batch * seq * steps / dt
-    tokens_per_sec_per_chip = tokens_per_sec / n_chips
-
+    n_chips = len(jax.devices())
+    tokens_per_sec_per_chip = batch * seq * steps / dt / n_chips
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params))
-    # 6ND for fwd+bwd; remat recomputes fwd once more -> ~8ND effective
-    # model flops (standard convention counts 6ND as "useful").
-    flops_per_token = 6.0 * n_params
-    achieved = tokens_per_sec_per_chip * flops_per_token
-    peak = _peak_flops(devices[0])
+    # 6ND model flops (standard convention; remat recompute not counted)
+    achieved = tokens_per_sec_per_chip * 6.0 * n_params
+    peak = _peak_flops(jax.devices()[0])
     mfu = achieved / peak if peak else 0.0
+    return model_name, tokens_per_sec_per_chip, mfu
+
+
+def bench_bert_large():
+    """BERT-large pretraining step with the fused transformer layer,
+    seq 128 (the reference's headline kernel benchmark: 272 samples/s /
+    64 TFLOPS on 1x V100, bert-pretraining.md:387)."""
+    from deepspeed_tpu.models.bert import BertForPreTrainingLM, bert_config
+
+    batch, seq, steps, warmup = 128, 128, 10, 3
+    cfg = bert_config("bert-large", max_position_embeddings=seq,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0, bf16=True)
+    model = BertForPreTrainingLM(cfg)
+    example = {"input_ids": np.zeros((batch, seq), np.int32)}
+    params = model.init(jax.random.PRNGKey(0), example)
+
+    def make_batch(i):
+        r = np.random.default_rng(i)
+        ids = r.integers(0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
+        labels = np.where(r.random((1, batch, seq)) < 0.15, ids, -100)
+        return {"input_ids": ids,
+                "masked_lm_labels": labels.astype(np.int32),
+                "next_sentence_label": r.integers(
+                    0, 2, (1, batch)).astype(np.int32)}
+
+    dt = _run_engine(model, params, {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+    }, make_batch, steps, warmup)
+
+    # per-chip so the number stays comparable to the 1x V100 baseline
+    samples_per_sec = batch * steps / dt / len(jax.devices())
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    tflops = samples_per_sec * seq * 6.0 * n_params / 1e12
+    return {"samples_per_sec_per_chip": round(samples_per_sec, 1),
+            "tflops_per_chip": round(tflops, 1),
+            "vs_v100_published": round(samples_per_sec / 272.0, 2)}
+
+
+def bench_sparse_16k():
+    """Block-sparse vs dense flash attention, fwd+bwd, 16k context
+    (BASELINE config 5; reference claims up to 6.3x over dense)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.sparse_attention import (SparseSelfAttention,
+                                                    FixedSparsityConfig)
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention
+
+    b, t, h, d = 1, 16384, 16, 64
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((b, t, h, d)),
+                           jnp.bfloat16) for _ in range(3))
+
+    sparse = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=h, block=128, num_local_blocks=4,
+                            num_global_blocks=1), max_seq_length=t)
+
+    def timed(fn):
+        grad = jax.jit(jax.grad(
+            lambda q: fn(q).astype(jnp.float32).sum()))
+        grad(q).block_until_ready()  # compile
+        t0 = time.perf_counter()
+        for _ in range(5):
+            out = grad(q)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / 5
+
+    t_sparse = timed(lambda q: sparse(q, k, v, causal=True))
+    t_dense = timed(lambda q: flash_attention(q, k, v, causal=True))
+    return {"seq_len": t, "sparse_ms": round(t_sparse * 1e3, 2),
+            "dense_ms": round(t_dense * 1e3, 2),
+            "speedup_vs_dense": round(t_dense / t_sparse, 2)}
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    model_name, tps, mfu = bench_gpt2(on_tpu)
+
+    extra = {"gpt2_mfu": round(mfu, 4)}
+    if on_tpu:
+        for name, fn in (("bert_large_fused_seq128", bench_bert_large),
+                         ("sparse_attention_16k", bench_sparse_16k)):
+            try:
+                extra[name] = fn()
+            except Exception as e:  # a failed extra must not kill the line
+                extra[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     print(json.dumps({
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec_per_chip, 1),
+        "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
+        "extra": extra,
     }))
 
 

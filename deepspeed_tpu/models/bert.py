@@ -109,7 +109,10 @@ class BertEncoder(nn.Module):
         class Cell(nn.Module):
             @nn.compact
             def __call__(self, h, mask, det):
-                return DeepSpeedTransformerLayer(ds_cfg)(h, mask, det), None
+                out = DeepSpeedTransformerLayer(ds_cfg)(h, mask, det)
+                # scan carry must be dtype-stable: the fused layer's
+                # residual/LN path is fp32 while the carry may be bf16
+                return out.astype(h.dtype), None
 
         Scanned = nn.scan(
             Cell,

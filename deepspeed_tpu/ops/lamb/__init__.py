@@ -1,0 +1,3 @@
+from deepspeed_tpu.ops.lamb.fused_lamb import FusedLamb, lamb
+
+__all__ = ["FusedLamb", "lamb"]

@@ -1,0 +1,110 @@
+"""Reference-parity LAMB optimizer.
+
+Counterpart of `deepspeed/ops/lamb/fused_lamb.py:38` and the CUDA
+kernel's update rule (`csrc/lamb/fused_lamb_cuda_kernel.cu:279-306`):
+
+    m = b1*m + (1-b1)*g ;  v = b2*v + (1-b2)*g^2
+    u = m_hat / (sqrt(v_hat) + eps) + weight_decay * w     (eps mode 1)
+    coeff = ||w|| / ||u||   clipped to [min_coeff, max_coeff],
+            1.0 when either norm is zero
+    w <- w - lr * coeff * u
+
+optax.lamb differs in one observable way — it never clips the trust
+ratio (the reference clips to [0.01, 10.0] by default,
+`ops/lamb/fused_lamb.py:48-49`), which changes early-training behavior
+when moments are tiny — so the engine wires THIS transformation for
+`"type": "Lamb"`. On TPU the whole update fuses into the train step;
+the per-tensor norm reductions XLA emits are the analogue of the CUDA
+kernel's two-pass block reduction.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class LambState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def _lamb(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+          weight_decay=0.0, max_coeff=10.0, min_coeff=0.01,
+          bias_correction=True):
+    def init_fn(params):
+        zeros = lambda: jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return LambState(count=jnp.zeros([], jnp.int32),
+                         mu=zeros(), nu=zeros())
+
+    def update_fn(updates, state, params=None):
+        assert params is not None, "lamb requires params for trust ratio"
+        count = state.count + 1
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, state.nu, updates)
+        if bias_correction:
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+
+        def one(m, v, p):
+            u = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p
+            w_norm = jnp.sqrt(jnp.sum(p.astype(jnp.float32) ** 2))
+            u_norm = jnp.sqrt(jnp.sum(u ** 2))
+            coeff = jnp.clip(w_norm / jnp.where(u_norm == 0, 1.0, u_norm),
+                             min_coeff, max_coeff)
+            coeff = jnp.where((w_norm == 0) | (u_norm == 0), 1.0, coeff)
+            return -learning_rate * coeff * u
+
+        new_updates = jax.tree_util.tree_map(one, mu, nu, params)
+        return new_updates, LambState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def lamb(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+         weight_decay=0.0, max_coeff=10.0, min_coeff=0.01,
+         bias_correction=True):
+    """Scheduler-injectable reference-parity LAMB (only learning_rate is
+    a traced hyperparam; the rest stay static so Python-level gating on
+    weight_decay/bias_correction remains legal)."""
+    return optax.inject_hyperparams(
+        _lamb, static_args=('b1', 'b2', 'eps', 'weight_decay',
+                            'max_coeff', 'min_coeff', 'bias_correction'))(
+        learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, max_coeff=max_coeff,
+        min_coeff=min_coeff, bias_correction=bias_correction)
+
+
+class FusedLamb:
+    """Class-style facade mirroring ref `ops/lamb/fused_lamb.py:38`."""
+
+    def __init__(self, params=None, lr=1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
+                 min_coeff=0.01, amsgrad=False):
+        if amsgrad:
+            raise RuntimeError('FusedLamb does not support the AMSGrad '
+                               'variant.')
+        if eps_inside_sqrt:
+            raise NotImplementedError(
+                "eps_inside_sqrt (adam mode 0) is not implemented; the "
+                "reference default (mode 1) is used")
+        self.transformation = lamb(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=weight_decay, max_coeff=max_coeff,
+            min_coeff=min_coeff, bias_correction=bias_correction)
+
+    def init(self, params):
+        return self.transformation.init(params)
+
+    def update(self, grads, state, params=None):
+        return self.transformation.update(grads, state, params)

@@ -31,7 +31,7 @@ def _causal_visible(qi, ki, block):
     return ki * block <= qi * block + block - 1
 
 
-def _bs_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _bs_fwd_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                    m_scr, l_scr, acc_scr, *, sm_scale, causal, block,
                    num_heads):
     qi = pl.program_id(1)
@@ -46,7 +46,8 @@ def _bs_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     nq_l = pl.num_programs(1)
-    visible = layout_ref[(h_idx * nq_l + qi) * nq_l + ki] != 0
+    lay_h = head_map_ref[h_idx]
+    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
     if causal:
         visible = jnp.logical_and(visible,
                                   _causal_visible(qi, ki, block))
@@ -86,7 +87,7 @@ def _bs_fwd_kernel(layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = m_scr[:, :1] + jnp.log(l)
 
 
-def _bs_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+def _bs_bwd_dkv_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                        delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
                        sm_scale, causal, block, num_heads):
     ki = pl.program_id(1)
@@ -100,7 +101,8 @@ def _bs_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     nq_l = pl.num_programs(1)
-    visible = layout_ref[(h_idx * nq_l + qi) * nq_l + ki] != 0
+    lay_h = head_map_ref[h_idx]
+    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
     if causal:
         visible = jnp.logical_and(visible,
                                   _causal_visible(qi, ki, block))
@@ -140,7 +142,7 @@ def _bs_bwd_dkv_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bs_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+def _bs_bwd_dq_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                       delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
                       block, num_heads):
     qi = pl.program_id(1)
@@ -153,7 +155,8 @@ def _bs_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     nq_l = pl.num_programs(1)
-    visible = layout_ref[(h_idx * nq_l + qi) * nq_l + ki] != 0
+    lay_h = head_map_ref[h_idx]
+    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
     if causal:
         visible = jnp.logical_and(visible,
                                   _causal_visible(qi, ki, block))
@@ -189,12 +192,24 @@ def _bs_bwd_dq_kernel(layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flat_layout(layout):
-    """[H, nq, nk] -> flat [H*nq*nk] int32 for SMEM scalar prefetch."""
-    return jnp.asarray(layout, jnp.int32).reshape(-1)
+def _dedup_layout(layout):
+    """[H, nq, nk] concrete layout -> (head_map [H], flat unique
+    layouts) for SMEM scalar prefetch. Heads sharing a layout (the
+    default for every shipped SparsityConfig:
+    different_layout_per_head=False) collapse to ONE stored copy — at
+    16k context a per-head table would be H*nq*nk*4 = 4 MB of SMEM,
+    past the hardware limit, while the deduped table is
+    nq*nk*4 = 64 KB. Must be called on concrete (numpy) layouts, so it
+    runs once at the public entry point and the deduped arrays thread
+    through the custom-VJP residuals."""
+    lay = np.asarray(layout, np.int32)
+    unique, inverse = np.unique(lay, axis=0, return_inverse=True)
+    return (jnp.asarray(inverse.reshape(-1), jnp.int32),
+            jnp.asarray(unique, jnp.int32).reshape(-1))
 
 
-def _bs_fwd(q, k, v, layout, sm_scale, causal, block, interpret):
+def _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
+            interpret):
     b, t, h, d = q.shape
     bh = b * h
     nq = t // block
@@ -205,7 +220,7 @@ def _bs_fwd(q, k, v, layout, sm_scale, causal, block, interpret):
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block=block, num_heads=h)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(bh, nq, nq),
         in_specs=[
             pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
@@ -230,12 +245,12 @@ def _bs_fwd(q, k, v, layout, sm_scale, causal, block, interpret):
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(_flat_layout(layout), to_bht(q), to_bht(k), to_bht(v))
+    )(head_map, lay_flat, to_bht(q), to_bht(k), to_bht(v))
     return out, lse
 
 
 def _bs_bwd(sm_scale, causal, block, interpret, res, g):
-    q, k, v, out, lse, layout = res
+    q, k, v, out, lse, head_map, lay_flat = res
     b, t, h, d = q.shape
     bh = b * h
     nq = t // block
@@ -250,12 +265,11 @@ def _bs_bwd(sm_scale, causal, block, interpret, res, g):
     ot = to_bht(out)
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1, keepdims=True)
-    flat_lay = _flat_layout(layout)
 
     dkv_kernel = functools.partial(_bs_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block=block, num_heads=h)
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(bh, nq, nq),
         in_specs=[
             pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, qi, 0)),
@@ -282,12 +296,12 @@ def _bs_bwd(sm_scale, causal, block, interpret, res, g):
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(flat_lay, qt, kt, vt, dot_, lse, delta)
+    )(head_map, lay_flat, qt, kt, vt, dot_, lse, delta)
 
     dq_kernel = functools.partial(_bs_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block=block, num_heads=h)
     dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(bh, nq, nq),
         in_specs=[
             pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
@@ -306,23 +320,27 @@ def _bs_bwd(sm_scale, causal, block, interpret, res, g):
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(flat_lay, qt, kt, vt, dot_, lse, delta)
+    )(head_map, lay_flat, qt, kt, vt, dot_, lse, delta)
 
-    return from_bht(dq), from_bht(dk), from_bht(dv), None
+    return from_bht(dq), from_bht(dk), from_bht(dv), None, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _bs_flash(q, k, v, layout, sm_scale, causal, block, interpret):
-    out, _ = _bs_fwd(q, k, v, layout, sm_scale, causal, block, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _bs_flash(q, k, v, head_map, lay_flat, sm_scale, causal, block,
+              interpret):
+    out, _ = _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal,
+                     block, interpret)
     b, t, h, d = q.shape
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _bs_flash_fwd(q, k, v, layout, sm_scale, causal, block, interpret):
-    out, lse = _bs_fwd(q, k, v, layout, sm_scale, causal, block, interpret)
+def _bs_flash_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
+                  interpret):
+    out, lse = _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal,
+                       block, interpret)
     b, t, h, d = q.shape
     out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return out_bthd, (q, k, v, out_bthd, lse, layout)
+    return out_bthd, (q, k, v, out_bthd, lse, head_map, lay_flat)
 
 
 _bs_flash.defvjp(_bs_flash_fwd, _bs_bwd)
@@ -342,6 +360,12 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
     layout: [H, T/block, T/block] 0/1 matrix from a SparsityConfig.
     """
     b, t, h, d = q.shape
+    if isinstance(layout, jax.core.Tracer):
+        raise ValueError(
+            "block_sparse_attention requires a CONCRETE layout (it is "
+            "deduplicated host-side for SMEM prefetch); build the "
+            "layout outside jit — SparsityConfig.make_layout returns "
+            "numpy and layouts are static per (config, seq_len)")
     layout = np.asarray(layout)
     assert layout.shape == (h, t // block, t // block), \
         (layout.shape, (h, t // block, t // block))
@@ -358,7 +382,8 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         sm_scale = 1.0 / np.sqrt(d)
     if interpret is None:
         interpret = not _on_tpu()
-    return _bs_flash(q, k, v, jnp.asarray(layout, jnp.int32),
+    head_map, lay_flat = _dedup_layout(layout)
+    return _bs_flash(q, k, v, head_map, lay_flat,
                      float(sm_scale), bool(causal), int(block),
                      bool(interpret))
 

@@ -167,8 +167,7 @@ def _json_restore(obj):
     return obj
 
 
-def save_checkpoint_files(save_dir, tag, model_sd, optim_sd,
-                          zero_enabled=False, mp_rank=0, dp_rank=0):
+def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0):
     """Write a sharded checkpoint.
 
     `model_sd` — dict with a "module" pytree of (possibly sharded) jax
@@ -273,17 +272,23 @@ def load_checkpoint_flat(load_dir, tag, mp_rank=0):
             flat[key] = main[key]
 
     shard_entries = []
-    for fname in sorted(os.listdir(ckpt_dir)):
-        m = _SHARD_RE.match(fname)
-        if not m or int(m.group(2)) != mp_rank:
-            continue
-        npz = np.load(os.path.join(ckpt_dir, fname))
-        with open(os.path.join(
-                ckpt_dir, fname[:-len(".npz")] + ".json")) as f:
-            bucket = json.load(f)
-        for entry in bucket["entries"]:
-            shard_entries.append((npz, entry))
-    _assemble(flat, shard_entries)
+    opened = []
+    try:
+        for fname in sorted(os.listdir(ckpt_dir)):
+            m = _SHARD_RE.match(fname)
+            if not m or int(m.group(2)) != mp_rank:
+                continue
+            npz = np.load(os.path.join(ckpt_dir, fname))
+            opened.append(npz)
+            with open(os.path.join(
+                    ckpt_dir, fname[:-len(".npz")] + ".json")) as f:
+                bucket = json.load(f)
+            for entry in bucket["entries"]:
+                shard_entries.append((npz, entry))
+        _assemble(flat, shard_entries)
+    finally:
+        for npz in opened:
+            npz.close()
     return (flat, _json_restore(manifest.get("meta", {})),
             _json_restore(manifest.get("optim_meta", {})),
             manifest.get("has_optim", False))
@@ -366,7 +371,8 @@ def validate_checkpoint_tag(tag, fail_on_mismatch=False):
            "processes; rank-unique tags break restores at different "
            "world sizes")
     if fail_on_mismatch:
-        assert valid, msg
+        if not valid:
+            raise ValueError(msg)
     elif not valid:
         from deepspeed_tpu.utils.logging import logger
         logger.warning(msg)

@@ -38,7 +38,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
-                                        build_mesh, data_sharding, replicated)
+                                        build_mesh, data_sharding,
+                                        replicated, stacked_batch_pspecs)
 from deepspeed_tpu.runtime.utils import _zeros_like_f32
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
 from deepspeed_tpu.runtime.zero.offload import ZeroOffloadMixin
@@ -430,7 +431,20 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # ------------------------------------------------------------------
     # optimizer construction (ref engine.py:544-630 selection matrix)
     # ------------------------------------------------------------------
+    def _pure_data_mesh(self):
+        """Stage-0 replicated params over a multi-device data-only mesh:
+        the scope where per-leaf shard_map collectives (CSR sparse
+        grads, 1-bit Adam's compressed allreduce) are legal — the same
+        scope as the reference's non-ZeRO fallback path."""
+        return (self.zero_optimization_stage() == 0 and
+                not self._offload_enabled() and
+                self.mesh.shape[DATA_AXIS] > 1 and
+                self.mesh.shape[MODEL_AXIS] == 1 and
+                self.mesh.shape[PIPE_AXIS] == 1)
+
     def _build_optimizer_transform(self):
+        self._use_onebit_shardmap = False
+        self._onebit_freeze_step = None
         if isinstance(self.client_optimizer, optax.GradientTransformation):
             # Client optax optimizer: wrap so lr can be injected if it
             # isn't already an inject_hyperparams transform.
@@ -447,12 +461,34 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         if name == C.ONEBIT_ADAM_OPTIMIZER:
             # 1-bit Adam (ref onebit_adam.py:18): freeze_step warmup then
-            # sign-compressed momentum with error feedback
+            # sign-compressed momentum with error feedback. On a
+            # multi-device pure-data mesh the engine compiles TWO step
+            # programs and switches at freeze_step — exactly the
+            # reference's host-side `enable_backward_allreduce = False`
+            # flip (ref onebit_adam.py:372): the warmup program carries
+            # the dense GSPMD grad reduction, the compressed program
+            # keeps grads local and communicates only bit-packed
+            # momentum signs inside shard_map.
             from deepspeed_tpu.runtime.fp16.onebit_adam import onebit_adam
-            return onebit_adam(
-                learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
-                weight_decay=weight_decay,
-                freeze_step=params.get("freeze_step", 100))
+            freeze_step = params.get("freeze_step", 100)
+            kw = dict(learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+                      weight_decay=weight_decay, freeze_step=freeze_step)
+            self._onebit_kwargs = kw
+            self._onebit_freeze_step = freeze_step
+            self._use_onebit_shardmap = self._pure_data_mesh()
+            if self._use_onebit_shardmap:
+                # worker_error is per-worker state: [dp] leading dim,
+                # sharded over the data axis (see onebit_adam docstring)
+                kw["num_workers"] = self.mesh.shape[DATA_AXIS]
+                self._onebit_kwargs = kw
+                return onebit_adam(**kw, static_phase="warmup")
+            if self.mesh.shape[DATA_AXIS] > 1:
+                logger.warning(
+                    "OnebitAdam compressed collective unavailable here "
+                    "(needs zero stage 0, no offload, and a pure-data "
+                    "mesh); falling back to the single-worker numerics "
+                    "form with dense gradient reduction")
+            return onebit_adam(**kw)
         if name in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
             # FusedAdam defaults to adam_w_mode (ref ops/adam/fused_adam.py);
             # decoupled weight decay is the TPU-native choice too.
@@ -465,9 +501,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             return optax.inject_hyperparams(optax.adam)(
                 learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps)
         if name == C.LAMB_OPTIMIZER:
-            return optax.inject_hyperparams(optax.lamb)(
+            # reference-parity LAMB (clipped trust ratio, ref
+            # csrc/lamb/fused_lamb_cuda_kernel.cu:279-306) — optax.lamb
+            # never clips the coefficient
+            from deepspeed_tpu.ops.lamb.fused_lamb import lamb as ds_lamb
+            return ds_lamb(
                 learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
-                weight_decay=weight_decay)
+                weight_decay=weight_decay,
+                max_coeff=params.get("max_coeff", 10.0),
+                min_coeff=params.get("min_coeff", 0.01),
+                bias_correction=params.get("bias_correction", True))
         if name == C.SGD_OPTIMIZER:
             momentum = params.get("momentum", 0.0)
             return optax.inject_hyperparams(optax.sgd)(
@@ -545,8 +588,26 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.mesh, self.zero_optimization_stage(), param_specs=tp_specs)
 
         self._param_shardings = self.zero_policy.param_shardings(params_f32)
-        self._master_shardings = self.zero_policy.master_shardings(params_f32)
-        self._acc_shardings = self.zero_policy.grad_accum_shardings(params_f32)
+
+        # Leaves with no dp-divisible dim are stored PADDED in the
+        # sharded state groups (master/moments/grad-accum) so they truly
+        # shard instead of silently replicating — the TPU-native form of
+        # the reference's sub-partition alignment (ref stage1.py:198-261).
+        # Compute-dtype params keep true shapes; padding is sliced off
+        # after each update and on checkpoint save.
+        self._zero_pad_plan = {}
+        if self.mixed_precision and not self._offload_enabled():
+            self._zero_pad_plan = self.zero_policy.pad_plan(params_f32)
+            if self._zero_pad_plan:
+                log_dist(
+                    f"ZeRO: padding {len(self._zero_pad_plan)} "
+                    "non-divisible leaves for data-axis sharding",
+                    ranks=[0])
+        params_enc = self.zero_policy.encode(params_f32,
+                                             self._zero_pad_plan)
+        self._master_shardings = self.zero_policy.master_shardings(params_enc)
+        self._acc_shardings = self.zero_policy.grad_accum_shardings(params_enc)
+        self._params_enc_template = params_enc
 
         if self.mixed_precision or self._offload_enabled():
             params = jax.tree_util.tree_map(
@@ -556,7 +617,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             # the fp32 master goes to device only when NOT offloading —
             # offload's whole point is keeping it in host RAM
             master = None if self._offload_enabled() else \
-                jax.device_put(params_f32, self._master_shardings)
+                jax.device_put(params_enc, self._master_shardings)
         else:
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
@@ -592,7 +653,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 "(wrap it with optax.inject_hyperparams); scheduler values "
                 "will not be applied")
         self._opt_shardings = self.zero_policy.opt_state_shardings(
-            opt_state, params_f32)
+            opt_state, self._params_enc_template)
+        if self._use_onebit_shardmap:
+            self._opt_shardings = self._opt_shardings._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda w: NamedSharding(
+                        self.mesh,
+                        PartitionSpec(DATA_AXIS,
+                                      *([None] * (w.ndim - 1)))),
+                    opt_state.worker_error))
         opt_state = jax.device_put(opt_state, self._opt_shardings)
 
         if self.fp16_mode:
@@ -613,7 +682,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self._jit_gas() == 1:
             acc = ()
         else:
-            acc = jax.device_put(_zeros_like_f32(params_f32),
+            acc = jax.device_put(_zeros_like_f32(self._params_enc_template),
                                  self._acc_shardings)
 
         self.state = EngineState(
@@ -652,6 +721,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                                        keep_prob)
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32), grads)
+        # pad-plan leaves: grads join the encoded (padded) layout here so
+        # accumulator/master/update shapes all agree; padding is zeros
+        grads = self.zero_policy.encode(grads, self._zero_pad_plan)
         grads = jax.lax.with_sharding_constraint(
             grads, self._acc_shardings)
         return raw_loss, grads
@@ -671,7 +743,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         Only used at ZeRO stage 0 (params replicated), matching the
         reference, whose CSR path lives in the non-ZeRO fallback
         (`engine.py:836,1160`)."""
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         from deepspeed_tpu.runtime.csr_tensor import csr_mean_rows
 
         sparse_paths = self._sparse_grad_paths()
@@ -715,18 +787,33 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             per_shard, mesh=mesh,
             in_specs=(P(), batch_specs, P(), P(), P()),
             out_specs=(P(), P()),
-            check_rep=False)(params, batch, rng, loss_scale, kp_in)
+            check_vma=False)(params, batch, rng, loss_scale, kp_in)
         return raw_loss, grads
 
     def _unscale_clip_and_update(self, state: EngineState, lr,
-                                 grads=None):
+                                 grads=None, transform=None,
+                                 local_axis=None):
         """Tail of the step: unscale, overflow vote, clip, cond-update.
-        `grads` (gas=1 fast path) bypasses the persistent accumulator."""
+        `grads` (gas=1 fast path) bypasses the persistent accumulator.
+        `transform` overrides self.optimizer_transform (1-bit Adam's
+        compressed-phase program). `local_axis`: set when running
+        per-shard inside shard_map with LOCAL grads — the norm becomes
+        sqrt(psum(|g_w|^2)/W) (exact when shards agree, conservative
+        otherwise, and continuous with the warmup path's global norm at
+        the phase transition), the clip factor derived from it is
+        identical on every worker, and sharding constraints (illegal
+        inside shard_map) are skipped."""
+        if transform is None:
+            transform = self.optimizer_transform
         scale = state.scale.loss_scale
         grads = jax.tree_util.tree_map(
             lambda g: g / scale,
             grads if grads is not None else state.acc_grads)
         grad_norm = _global_norm(grads)
+        if local_axis is not None:
+            w = self.mesh.shape[local_axis]
+            grad_norm = jnp.sqrt(
+                jax.lax.psum(grad_norm * grad_norm, local_axis) / w)
         if self.fp16_mode:
             overflow = ~jnp.isfinite(grad_norm)
         else:
@@ -742,7 +829,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         def do_update(target, opt_state):
             opt_state = self._with_lr(opt_state, lr)
-            updates, new_opt = self.optimizer_transform.update(
+            updates, new_opt = transform.update(
                 grads, opt_state, target)
             new_target = optax.apply_updates(target, updates)
             return new_target, new_opt
@@ -754,16 +841,20 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             overflow, skip_update, do_update, opt_target, state.opt_state)
 
         if self.mixed_precision:
-            new_master = jax.lax.with_sharding_constraint(
-                new_target, self._master_pspecs_cached)
+            new_master = new_target if local_axis is not None else \
+                jax.lax.with_sharding_constraint(
+                    new_target, self._master_pspecs_cached)
             new_params = jax.tree_util.tree_map(
-                lambda m: m.astype(self.compute_dtype), new_master)
-            new_params = jax.lax.with_sharding_constraint(
-                new_params, self._param_pspecs_cached)
+                lambda m: m.astype(self.compute_dtype),
+                self.zero_policy.decode(new_master, self._zero_pad_plan))
+            if local_axis is None:
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, self._param_pspecs_cached)
         else:
             new_master = None
-            new_params = jax.lax.with_sharding_constraint(
-                new_target, self._param_pspecs_cached)
+            new_params = new_target if local_axis is not None else \
+                jax.lax.with_sharding_constraint(
+                    new_target, self._param_pspecs_cached)
 
         dyn_args = self.dynamic_loss_scale_args() or {}
         new_scale = update_loss_scale(
@@ -799,6 +890,30 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             return opt_state._replace(hyperparams=hp)
         return opt_state
 
+    def _scan_microbatches(self, micro_fn, acc0, stacked_batch, rng, gas,
+                           force_scan=False):
+        """Accumulate over the gas microbatches of a stacked [gas, ...]
+        batch. micro_fn(mb, rng) -> (loss, grads). Returns
+        (grads_or_acc, mean_loss). gas==1 skips the accumulator and the
+        per-microbatch rng fold (grads flow straight to the update)
+        unless force_scan — the offload path always accumulates into
+        its persistent buffer."""
+        if gas == 1 and not force_scan:
+            mb = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
+            loss, grads = micro_fn(mb, rng)
+            return grads, loss
+
+        def body(carry, mb):
+            acc, i = carry
+            loss, grads = micro_fn(mb, jax.random.fold_in(rng, i))
+            acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            return (acc, i + 1), loss
+
+        (acc, _), losses = jax.lax.scan(
+            body, (acc0, jnp.asarray(0, jnp.int32)), stacked_batch,
+            length=gas)
+        return acc, jnp.mean(losses)
+
     def _build_step_fns(self):
         mesh = self.mesh
         self._master_pspecs_cached = jax.tree_util.tree_map(
@@ -810,12 +925,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # to stage 0 with a pure data mesh — the same scope as the
         # reference's buffered_allreduce_fallback CSR path.
         self._use_shardmap_grads = (
-            self.zero_optimization_stage() == 0 and
-            not self._offload_enabled() and
-            bool(self._sparse_grad_paths()) and
-            self.mesh.shape[DATA_AXIS] > 1 and
-            self.mesh.shape[MODEL_AXIS] == 1 and
-            self.mesh.shape[PIPE_AXIS] == 1)
+            self._pure_data_mesh() and bool(self._sparse_grad_paths()))
         if self.sparse_gradients_enabled() and \
                 not self._use_shardmap_grads and \
                 self.mesh.shape[DATA_AXIS] > 1:
@@ -845,60 +955,115 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self._build_offload_fns()
 
             def fused_grads_only(state, stacked_batch, rng, keep_prob):
-                def body(carry, mb):
-                    acc, i = carry
-                    mb_rng = jax.random.fold_in(rng, i)
-                    raw_loss, grads = self._micro_grad(
-                        state.params, mb, mb_rng, state.scale.loss_scale,
-                        keep_prob)
-                    acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                    return (acc, i + 1), raw_loss
-
-                (acc, _), losses = jax.lax.scan(
-                    body, (state.acc_grads, jnp.asarray(0, jnp.int32)),
-                    stacked_batch, length=gas)
-                return state._replace(acc_grads=acc), jnp.mean(losses)
+                micro = lambda mb, r: self._micro_grad(
+                    state.params, mb, r, state.scale.loss_scale, keep_prob)
+                acc, loss = self._scan_microbatches(
+                    micro, state.acc_grads, stacked_batch, rng, gas,
+                    force_scan=True)
+                return state._replace(acc_grads=acc), loss
 
             self._offload_grads_jit = jax.jit(fused_grads_only,
                                               donate_argnums=(0,))
 
         def fused_train_step(state, stacked_batch, rng, lr, keep_prob):
             """scan over gas microbatches then update; one compile."""
+            micro = lambda mb, r: self._micro_grad(
+                state.params, mb, r, state.scale.loss_scale, keep_prob)
+            out, loss = self._scan_microbatches(
+                micro, state.acc_grads, stacked_batch, rng, gas)
             if gas == 1:
                 # no accumulator: grads flow straight into the update
-                mb = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
-                raw_loss, grads = self._micro_grad(
-                    state.params, mb, rng, state.scale.loss_scale,
-                    keep_prob)
                 new_state, overflow, grad_norm = \
-                    self._unscale_clip_and_update(state, lr, grads=grads)
-                return new_state, raw_loss, overflow, grad_norm
-
-            def body(carry, mb):
-                acc, i = carry
-                mb_rng = jax.random.fold_in(rng, i)
-                raw_loss, grads = self._micro_grad(
-                    state.params, mb, mb_rng, state.scale.loss_scale,
-                    keep_prob)
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                return (acc, i + 1), raw_loss
-
-            (acc, _), losses = jax.lax.scan(
-                body, (state.acc_grads, jnp.asarray(0, jnp.int32)),
-                stacked_batch, length=gas)
-            state = state._replace(acc_grads=acc)
-            new_state, overflow, grad_norm = \
-                self._unscale_clip_and_update(state, lr)
-            return new_state, jnp.mean(losses), overflow, grad_norm
+                    self._unscale_clip_and_update(state, lr, grads=out)
+            else:
+                state = state._replace(acc_grads=out)
+                new_state, overflow, grad_norm = \
+                    self._unscale_clip_and_update(state, lr)
+            return new_state, loss, overflow, grad_norm
 
         self._fused_step_jit = jax.jit(fused_train_step,
                                        donate_argnums=(0,))
+
+        self._onebit_compressed_active = False
+        self._onebit_warned_manual = False
+        if self._use_onebit_shardmap:
+            self._build_onebit_compressed_step()
 
         def eval_fn(params, batch):
             return self._loss_fn(params, batch, rngs=None,
                                  deterministic=True)
 
         self._eval_jit = jax.jit(eval_fn)
+
+    def _build_onebit_compressed_step(self):
+        """Compressed-phase 1-bit Adam step (ref `onebit_adam.py:330-372`):
+        the whole train step runs inside one shard_map over the data
+        axis. Gradients stay LOCAL to each data shard — there is no
+        dense reduction anywhere in this program (the reference
+        achieves this by flipping `enable_backward_allreduce = False`
+        at freeze_step) — and the only cross-shard traffic is the
+        bit-packed sign payload + one fp32 scale per worker inside
+        `compressed_allreduce` (~1/32 of the dense fp32 wire volume).
+        Params/opt-state are replicated in and provably identical out:
+        every shard decodes the same gathered signs, so the update is
+        deterministic across workers."""
+        from jax import shard_map
+        from deepspeed_tpu.runtime.fp16.onebit_adam import onebit_adam
+
+        transform = onebit_adam(**self._onebit_kwargs,
+                                axis_name=DATA_AXIS,
+                                static_phase="compressed")
+        mesh = self.mesh
+        gas = self._jit_gas()
+
+        def local_step(state, stacked_batch, rng, lr, keep_prob):
+            def micro(mb, mb_rng):
+                mb_rng = jax.random.fold_in(
+                    mb_rng, jax.lax.axis_index(DATA_AXIS))
+                grad_fn = jax.value_and_grad(self._scaled_loss_fn,
+                                             has_aux=True)
+                (_, raw_loss), grads = grad_fn(
+                    state.params, mb, mb_rng, state.scale.loss_scale,
+                    keep_prob)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32), grads)
+                return jax.lax.pmean(raw_loss, DATA_AXIS), grads
+
+            grads, loss = self._scan_microbatches(
+                micro, _zeros_like_f32(state.params), stacked_batch,
+                rng, gas)
+            new_state, overflow, grad_norm = \
+                self._unscale_clip_and_update(
+                    state, lr, grads=grads, transform=transform,
+                    local_axis=DATA_AXIS)
+            return new_state, loss, overflow, grad_norm
+
+        P = PartitionSpec
+
+        def state_specs(state):
+            """Everything replicated EXCEPT worker_error, whose leading
+            [dp] dim is sharded over data: each worker owns its error-
+            feedback slice (it diverges per worker by construction, so
+            declaring it replicated would silently collapse it on
+            checkpoint/reshard)."""
+            specs = jax.tree_util.tree_map(lambda _: P(), state)
+            return specs._replace(opt_state=specs.opt_state._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda w: P(DATA_AXIS, *([None] * (w.ndim - 1))),
+                    state.opt_state.worker_error)))
+
+        def compressed_step(state, stacked_batch, rng, lr, keep_prob):
+            batch_specs = stacked_batch_pspecs(stacked_batch)
+            st_specs = state_specs(state)
+            return shard_map(
+                local_step, mesh=mesh,
+                in_specs=(st_specs, batch_specs, P(), P(), P()),
+                out_specs=(st_specs, P(), P(), P()),
+                check_vma=False)(state, stacked_batch, rng, lr,
+                                 keep_prob)
+
+        self._onebit_compressed_jit = jax.jit(compressed_step,
+                                              donate_argnums=(0,))
 
     # ------------------------------------------------------------------
     # data path
@@ -1021,6 +1186,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self._host_steps += 1
             self._after_model_step(jnp.asarray(overflow))
             return
+        if self._use_onebit_shardmap and not self._onebit_warned_manual \
+                and self._host_steps >= self._onebit_freeze_step:
+            # the compressed program exists only on the fused
+            # train_batch path; the manual API would run warmup Adam
+            # forever past freeze_step — say so once
+            logger.warning(
+                "OnebitAdam: forward()/backward()/step() never enters "
+                "the compressed phase; use train_batch() to get the "
+                "bit-packed collective past freeze_step")
+            self._onebit_warned_manual = True
         self.state, overflow, grad_norm = self._apply_jit(self.state, lr)
         self._host_steps += 1
         self._after_model_step(overflow)
@@ -1096,7 +1271,30 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             overflow = jnp.asarray(self._offload_take_step(lr))
             grad_norm = None
         else:
-            self.state, loss, overflow, grad_norm = self._fused_step_jit(
+            step_fn = self._fused_step_jit
+            if self._use_onebit_shardmap:
+                # Host-side phase switch at freeze_step (the XLA-native
+                # form of ref onebit_adam.py:372's
+                # enable_backward_allreduce flip): one recompile, after
+                # which no dense grad reduction exists in the program.
+                # Keyed on the OPTIMIZER's step count (like the
+                # reference's state['step']) so a reload with
+                # load_optimizer_states=False correctly re-warms; the
+                # cheap host-step pre-check keeps the warmup hot loop
+                # free of device_get syncs (count <= host steps always).
+                if not self._onebit_compressed_active and \
+                        self._host_steps >= self._onebit_freeze_step and \
+                        int(jax.device_get(self.state.opt_state.count)) \
+                        >= self._onebit_freeze_step:
+                    self._onebit_compressed_active = True
+                    log_dist(
+                        "OnebitAdam: entering compressed phase "
+                        f"(freeze_step={self._onebit_freeze_step}); "
+                        "momentum now rides the bit-packed collective",
+                        ranks=[0])
+                if self._onebit_compressed_active:
+                    step_fn = self._onebit_compressed_jit
+            self.state, loss, overflow, grad_norm = step_fn(
                 self.state, batch, self._next_rng(), lr, self._keep_prob())
         mbs = self._microbatches_per_step()
         self.micro_steps += mbs
@@ -1172,7 +1370,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def fp32_params(self):
         if self._offload_enabled():
             return self._offload_unravel(jnp.asarray(self._host_master))
-        return self.state.master if self.mixed_precision else self.state.params
+        if self.mixed_precision:
+            return self.zero_policy.decode(self.state.master,
+                                           self._zero_pad_plan)
+        return self.state.params
 
     # ------------------------------------------------------------------
     # checkpointing (ref engine.py:1248-1573; layout preserved)
@@ -1189,8 +1390,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # (ref pipe/module.py:536-567)
         per_layer = hasattr(self.module, "save_state_dict") and \
             hasattr(self.module, "load_state_dir")
-        if per_layer and jax.process_index() == 0:
-            import os
+        if per_layer:
+            # all processes participate (per-layer gathers are
+            # collectives on multi-host shardings); proc 0 writes
             self.module.save_state_dict(
                 os.path.join(save_dir, str(tag)), self.fp32_params)
         # module/opt_state stay as (possibly sharded) jax arrays: the
@@ -1208,15 +1410,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         )
         sd.update(client_state or {})
         optim_sd = dict(
-            opt_state=self.state.opt_state,
+            # pad-plan leaves save in true (unpadded) shapes so the
+            # checkpoint stays elastic across dp sizes
+            opt_state=self.zero_policy.decode(
+                self.state.opt_state, self._zero_pad_plan,
+                suffix_match=True),
             scale=jax.device_get(self.state.scale),
             zero_stage=self.zero_optimization_stage(),
         )
         if self._offload_enabled():
             optim_sd["host_adam"] = self._host_adam.state_dict()
             optim_sd["host_master"] = self._host_master
-        save_checkpoint_files(save_dir, tag, sd, optim_sd,
-                              zero_enabled=self.zero_optimization())
+        save_checkpoint_files(save_dir, tag, sd, optim_sd)
         if save_latest and jax.process_index() == 0:
             write_latest_tag(save_dir, tag)
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
@@ -1244,7 +1449,6 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             opt_state_template=self.state.opt_state,
             aux_templates=aux_templates)
         if per_layer and "module" not in sd:
-            import os
             sd["module"] = self.module.load_state_dir(
                 os.path.join(load_dir, str(tag)), self.state.params)
 
@@ -1259,7 +1463,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     jnp.asarray(x, self.compute_dtype), s),
                 params_f32, self._param_shardings)
             master = None if self._offload_enabled() else \
-                jax.device_put(params_f32, self._master_shardings)
+                jax.device_put(
+                    self.zero_policy.encode(params_f32,
+                                            self._zero_pad_plan),
+                    self._master_shardings)
         else:
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
@@ -1290,18 +1497,50 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     "(saved without cpu_offload?); masters restored "
                     "from module weights, Adam moments reset")
         elif load_optimizer_states and optim_sd is not None:
-            opt_state = jax.tree_util.tree_map(
-                lambda cur, saved: jax.device_put(
-                    jnp.asarray(saved), cur.sharding),
-                self.state.opt_state, optim_sd["opt_state"])
-            scale = LossScaleState(*[jnp.asarray(x)
-                                     for x in optim_sd["scale"]])
+            if optim_sd.get("opt_state") is None:
+                # loader's structure-mismatch fallback (checkpoint saved
+                # with a different optimizer): keep fresh moments
+                logger.warning(
+                    "checkpoint optimizer state does not match the "
+                    "current optimizer (different type?); optimizer "
+                    "moments reset")
+            else:
+                # checkpoints store true shapes; re-enter the padded
+                # layout (computed for the CURRENT dp size — elastic)
+                restored = self.zero_policy.encode(
+                    jax.tree_util.tree_map(jnp.asarray,
+                                           optim_sd["opt_state"]),
+                    self._zero_pad_plan, suffix_match=True)
+                mismatched = []
+
+                def put(cur, saved):
+                    if saved.shape != cur.shape:
+                        # per-worker state saved at a different world
+                        # size (1-bit Adam worker_error [old_dp, ...]):
+                        # keep the fresh init — error feedback is
+                        # worker-local and safely restarts from zero
+                        mismatched.append((saved.shape, cur.shape))
+                        return cur
+                    return jax.device_put(saved, cur.sharding)
+
+                opt_state = jax.tree_util.tree_map(
+                    put, self.state.opt_state, restored)
+                if mismatched:
+                    logger.warning(
+                        f"{len(mismatched)} optimizer-state leaves were "
+                        "saved at a different world size and were reset "
+                        f"(e.g. {mismatched[0][0]} vs {mismatched[0][1]})")
+            if optim_sd.get("scale") is not None:
+                scale = LossScaleState(*[jnp.asarray(x)
+                                         for x in optim_sd["scale"]])
 
         if self._jit_gas() == 1 and not self._offload_enabled():
             acc_restored = ()
         else:
-            acc_restored = jax.device_put(_zeros_like_f32(params_f32),
-                                          self._acc_shardings)
+            acc_restored = jax.device_put(
+                _zeros_like_f32(self.zero_policy.encode(
+                    params_f32, self._zero_pad_plan)),
+                self._acc_shardings)
         self.state = EngineState(
             params=params, master=master, opt_state=opt_state, scale=scale,
             acc_grads=acc_restored,
@@ -1310,6 +1549,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 sd.get("global_steps", 0) - sd.get("skipped_steps", 0),
                 jnp.int32))
         self.micro_steps = sd.get("micro_steps", 0)
+        self._host_steps = self.micro_steps // max(
+            1, self.gradient_accumulation_steps())
+        # re-derive the 1-bit Adam phase: the next train_batch re-checks
+        # the restored optimizer count (a load with
+        # load_optimizer_states=False resets count=0 and correctly
+        # re-warms rather than freezing an all-zero variance)
+        self._onebit_compressed_active = False
         if "rng" in sd and sd["rng"] is not None:
             self._rng = jnp.asarray(sd["rng"])
 

@@ -87,46 +87,83 @@ class OnebitAdamState(NamedTuple):
     exp_avg_sq: optax.Updates     # variance, frozen after freeze_step
     worker_error: optax.Updates
     server_error: optax.Updates
+    hyperparams: dict             # {"learning_rate"}: scheduler-injectable
 
 
 def onebit_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                 weight_decay=0.0, freeze_step=100,
-                axis_name: Optional[str] = None):
+                axis_name: Optional[str] = None,
+                static_phase: Optional[str] = None,
+                num_workers: int = 1):
     """optax transformation implementing 1-bit Adam
     (ref `OnebitAdam`, `onebit_adam.py:18`).
 
     axis_name: data axis for the compressed allreduce when the update
-    runs inside shard_map. None = single-worker form (W=1): momentum is
-    still sign-compressed with error feedback after freeze_step, which
-    preserves the algorithm's convergence behavior without collectives.
+    runs inside shard_map. Requires static_phase="compressed"; in that
+    mode `updates` are the LOCAL per-shard gradients (the engine turns
+    off its dense gradient reduction, mirroring the reference's
+    `enable_backward_allreduce = False` flip at `onebit_adam.py:372`)
+    and the momentum rides the bit-packed collective.
+
+    static_phase: compile exactly one phase instead of computing both
+    and selecting. The reference switches host-side at freeze_step; the
+    XLA-native equivalent is one recompile at the phase boundary, so
+    the compressed-phase program contains *no* dense reduction at all:
+      None          — dynamic select (single-worker numerics form; both
+                      branches traced, chosen by the step count)
+      "warmup"      — plain Adam (updates already averaged by GSPMD)
+      "compressed"  — frozen variance + sign-compressed momentum only
+
+    num_workers: size of the data axis. When > 1, worker_error leaves
+    carry a leading [num_workers] dim — error feedback is inherently
+    PER-WORKER state (each worker compresses a different local
+    momentum, ref `onebit_adam.py:305` allocates it per rank), so under
+    SPMD its honest global representation is an array sharded over the
+    data axis, one slice per worker. Inside shard_map each worker sees
+    its own [1, ...] slice. server_error stays replicated: every
+    worker computes the identical server-stage compression of the
+    identical gathered average. Requires a static phase (the dynamic
+    form is the single-worker numerics form).
     """
+    if axis_name is not None and static_phase != "compressed":
+        raise ValueError(
+            "axis_name requires static_phase='compressed': local-grad "
+            "semantics only hold in the compressed phase")
+    if num_workers > 1 and static_phase is None:
+        raise ValueError(
+            "num_workers > 1 requires a static phase; the dynamic form "
+            "is single-worker only")
 
     def init_fn(params):
         zeros = lambda: jax.tree_util.tree_map(
             lambda p: jnp.zeros_like(p, jnp.float32), params)
+        if num_workers > 1:
+            worker_error = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((num_workers,) + p.shape, jnp.float32),
+                params)
+        else:
+            worker_error = zeros()
         return OnebitAdamState(
             count=jnp.zeros([], jnp.int32),
             exp_avg=zeros(), exp_avg_sq=zeros(),
-            worker_error=zeros(), server_error=zeros())
+            worker_error=worker_error, server_error=zeros(),
+            hyperparams={"learning_rate": jnp.asarray(learning_rate,
+                                                      jnp.float32)})
 
-    def update_fn(updates, state, params=None):
-        count = state.count + 1
-        in_warmup = count <= freeze_step
+    def warm_moments(updates, state):
+        exp_avg = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.exp_avg, updates)
+        exp_avg_sq = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g,
+            state.exp_avg_sq, updates)
+        return exp_avg, exp_avg_sq
 
-        def warm_moment(m, g):
-            return b1 * m + (1 - b1) * g
-
-        def warm_var(v, g):
-            return b2 * v + (1 - b2) * g * g
-
-        exp_avg_warm = jax.tree_util.tree_map(warm_moment, state.exp_avg,
-                                              updates)
-        exp_avg_sq_warm = jax.tree_util.tree_map(warm_var,
-                                                 state.exp_avg_sq, updates)
-
-        # compressed phase: momentum update then sign-compress with
-        # error feedback (variance frozen)
-        def compressed_moment(m, g, werr, serr):
+    def compressed_moments(updates, state):
+        """Momentum update from (possibly local) grads, then
+        sign-compress with error feedback; variance frozen."""
+        def one(m, g, werr, serr):
+            # with num_workers > 1 inside shard_map, werr is this
+            # worker's local [1, *m.shape] slice — same element count
             m_new = b1 * m + (1 - b1) * g
             flat = m_new.reshape(-1)
             if axis_name is not None:
@@ -136,35 +173,52 @@ def onebit_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
                 scale, packed, werr_new = compress(flat, werr.reshape(-1))
                 out = unpack_signs(packed, flat.shape[0]) * scale
                 serr_new = serr.reshape(-1)
-            return (out.reshape(m.shape), werr_new.reshape(m.shape),
-                    serr_new.reshape(m.shape))
+            return (out.reshape(m.shape), werr_new.reshape(werr.shape),
+                    serr_new.reshape(serr.shape))
 
         comp = jax.tree_util.tree_map(
-            compressed_moment, state.exp_avg, updates,
+            one, state.exp_avg, updates,
             state.worker_error, state.server_error)
-        # unzip the 3-tuples
         treedef = jax.tree_util.tree_structure(state.exp_avg)
         flat_comp = treedef.flatten_up_to(comp)
-        exp_avg_comp = treedef.unflatten([c[0] for c in flat_comp])
-        werr_new = treedef.unflatten([c[1] for c in flat_comp])
-        serr_new = treedef.unflatten([c[2] for c in flat_comp])
+        exp_avg = treedef.unflatten([c[0] for c in flat_comp])
+        werr = treedef.unflatten([c[1] for c in flat_comp])
+        serr = treedef.unflatten([c[2] for c in flat_comp])
+        return exp_avg, werr, serr
 
-        pick = lambda a, b: jax.tree_util.tree_map(
-            lambda x, y: jnp.where(in_warmup, x, y), a, b)
-        exp_avg = pick(exp_avg_warm, exp_avg_comp)
-        exp_avg_sq = pick(exp_avg_sq_warm, state.exp_avg_sq)
-        worker_error = pick(state.worker_error, werr_new)
-        server_error = pick(state.server_error, serr_new)
+    def update_fn(updates, state, params=None):
+        count = state.count + 1
+
+        if static_phase == "warmup":
+            exp_avg, exp_avg_sq = warm_moments(updates, state)
+            worker_error = state.worker_error
+            server_error = state.server_error
+        elif static_phase == "compressed":
+            exp_avg, worker_error, server_error = \
+                compressed_moments(updates, state)
+            exp_avg_sq = state.exp_avg_sq
+        else:
+            in_warmup = count <= freeze_step
+            exp_avg_warm, exp_avg_sq_warm = warm_moments(updates, state)
+            exp_avg_comp, werr_new, serr_new = \
+                compressed_moments(updates, state)
+            pick = lambda a, b: jax.tree_util.tree_map(
+                lambda x, y: jnp.where(in_warmup, x, y), a, b)
+            exp_avg = pick(exp_avg_warm, exp_avg_comp)
+            exp_avg_sq = pick(exp_avg_sq_warm, state.exp_avg_sq)
+            worker_error = pick(state.worker_error, werr_new)
+            server_error = pick(state.server_error, serr_new)
 
         bias1 = 1 - b1 ** count.astype(jnp.float32)
         bias2 = 1 - b2 ** jnp.minimum(
             count, freeze_step).astype(jnp.float32)
+        lr = state.hyperparams["learning_rate"]
 
         def step_update(m, v, p):
             denom = jnp.sqrt(v / bias2) + eps
-            upd = -(learning_rate / bias1) * (m / denom)
+            upd = -(lr / bias1) * (m / denom)
             if weight_decay:
-                upd = upd - learning_rate * weight_decay * p
+                upd = upd - lr * weight_decay * p
             return upd
 
         new_updates = jax.tree_util.tree_map(
@@ -172,7 +226,8 @@ def onebit_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
             params if params is not None else exp_avg)
         return new_updates, OnebitAdamState(
             count=count, exp_avg=exp_avg, exp_avg_sq=exp_avg_sq,
-            worker_error=worker_error, server_error=server_error)
+            worker_error=worker_error, server_error=server_error,
+            hyperparams=state.hyperparams)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -183,13 +238,20 @@ class OnebitAdam:
 
     def __init__(self, params=None, lr=1e-3, freeze_step=100,
                  betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
-                 cuda_aware=False, axis_name=None):
+                 cuda_aware=False, axis_name=None, static_phase=None,
+                 num_workers=1):
         if cuda_aware:
             logger.warning("cuda_aware is meaningless on TPU; ignored")
+        if axis_name is not None and static_phase is None:
+            # shard_map callers get the compressed collective; the
+            # warmup program must be built separately (see the engine's
+            # two-program construction)
+            static_phase = "compressed"
         self.transformation = onebit_adam(
             learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
             weight_decay=weight_decay, freeze_step=freeze_step,
-            axis_name=axis_name)
+            axis_name=axis_name, static_phase=static_phase,
+            num_workers=num_workers)
         self.freeze_step = freeze_step
 
     def init(self, params):

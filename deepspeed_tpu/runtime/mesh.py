@@ -67,3 +67,17 @@ def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
 def batch_sharding_for_tree(mesh: Mesh, tree):
     return jax.tree_util.tree_map(
         lambda x: data_sharding(mesh, np.ndim(x)), tree)
+
+
+def stacked_batch_pspecs(tree):
+    """PartitionSpecs for a microbatch-stacked batch pytree
+    [gas, batch, ...]: shard dim 1 (the per-microbatch batch dim) over
+    the data axis; scalars/1-D leaves stay replicated. Shared by every
+    shard_map entry point that consumes the fused step's stacked batch
+    (sparse-grad path, 1-bit Adam compressed path, pipeline executor)."""
+    def one(x):
+        spec = [None] * np.ndim(x)
+        if np.ndim(x) > 1:
+            spec[1] = DATA_AXIS
+        return PartitionSpec(*spec)
+    return jax.tree_util.tree_map(one, tree)

@@ -13,13 +13,15 @@ communication are *compiled*:
     `pipe` mesh axis, activation rotation lowered to collective-permute
     (see `models/gpt2_pipe.py`). Backward-pipeline scheduling falls out
     of autodiff. This is the performance path.
-  * arbitrary PipelineModules (heterogeneous layers/shapes) run the
-    layer chain sequentially inside the fused step — pipeline
-    *semantics* (microbatching, tied weights, loss parity with a dense
-    baseline, the criterion the reference's own `test_pipe.py` asserts)
-    without inter-stage overlap on one controller. The TrainSchedule
-    instruction stream (`schedule.py`) remains the source of truth for
-    host-driven multi-controller execution.
+  * arbitrary PipelineModules (heterogeneous layers/shapes) on a
+    pipe>1 mesh execute the compiled 1F1B interpreter
+    (`pipe/interp.py`): the TrainSchedule instruction streams are
+    clock-aligned at build time and lowered to a shard_map scan whose
+    pipe shards each run THEIR stage via lax.switch, with ppermute
+    activation/cotangent flow and recompute-based backward bounded by
+    `num_pipe_buffers()` saved stage inputs. On a pipe=1 mesh the
+    layer chain runs sequentially inside the fused step (pure
+    microbatching semantics, no overlap to be had).
 
 The train_batch/eval_batch API and loss aggregation semantics
 (ref `engine.py:244,320,388-418`) are preserved.
@@ -32,7 +34,7 @@ import jax
 import numpy as np
 
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _fetch_to_host
-from deepspeed_tpu.runtime.mesh import PIPE_AXIS
+from deepspeed_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.topology import PipelineParallelGrid
 from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
@@ -67,11 +69,19 @@ class PipelineEngine(DeepSpeedEngine):
             raise RuntimeError(
                 "Elasticity is not currently supported with pipeline "
                 "parallelism.")  # parity: ref pipe/engine.py:57
+        if self._is_pipe_module and self.pld_enabled():
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "progressive_layer_drop has no effect on PipelineModule "
+                "engines (neither the sequential chain nor the 1F1B "
+                "executor plumbs layer_keep_prob)")
 
+        mode = ("spmd" if self._pipelined_protocol else
+                "1f1b" if getattr(self, "_use_1f1b", False) else
+                "sequential")
         log_dist(
             f"PipelineEngine: stages={self.num_stages}, "
-            f"micro_batches={self.micro_batches}, "
-            f"mode={'spmd' if self._pipelined_protocol else 'sequential'}",
+            f"micro_batches={self.micro_batches}, mode={mode}",
             ranks=[0])
 
     # ------------------------------------------------------------------
@@ -131,6 +141,59 @@ class PipelineEngine(DeepSpeedEngine):
             super()._microbatches_per_step()
 
     # ------------------------------------------------------------------
+    # compiled 1F1B execution for heterogeneous PipelineModules
+    # ------------------------------------------------------------------
+    def _build_step_fns(self):
+        super()._build_step_fns()
+        self._use_1f1b = (
+            self._is_pipe_module and
+            self.mesh.shape[PIPE_AXIS] > 1 and
+            self.mesh.shape[MODEL_AXIS] == 1 and
+            self.gradient_accumulation_steps() > 1)
+        self._interp_fn = None
+        if not self._use_1f1b:
+            return
+
+        def pipe_step(state, stacked_batch, rng, lr, keep_prob):
+            loss, grads = self._interp_fn(
+                state.params, stacked_batch, rng, state.scale.loss_scale)
+            # join the padded layout when ZeRO pads odd leaves (same as
+            # _micro_grad's exit path)
+            grads = self.zero_policy.encode(grads, self._zero_pad_plan)
+            new_state, overflow, grad_norm = \
+                self._unscale_clip_and_update(state, lr, grads=grads)
+            return new_state, loss, overflow, grad_norm
+
+        # the base train_batch dispatches whatever _fused_step_jit is;
+        # the 1F1B program replaces the sequential-chain scan
+        self._fused_step_jit = jax.jit(pipe_step, donate_argnums=(0,))
+
+    def _ensure_interp(self, stacked_batch):
+        """Lazy-build the compiled 1F1B step: boundary shapes come from
+        the first batch (one LOCAL microbatch as seen inside shard_map:
+        the per-microbatch batch dim divides over the data axis)."""
+        if self._interp_fn is not None:
+            return
+        from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
+        dp = self.mesh.shape[DATA_AXIS]
+        example_mb = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                (np.asarray(x).shape[1] // dp,) + np.asarray(x).shape[2:],
+                np.asarray(x).dtype),
+            stacked_batch)
+        self._interp_fn = build_pipeline_step(
+            module=self.module, mesh=self.mesh,
+            micro_batches=self.micro_batches,
+            params_example=self.state.params,
+            batch_example=example_mb,
+            split_batch=_split_batch,
+            det_accepting=_layers_accepting_deterministic(self.module))
+        log_dist(
+            f"PipelineEngine: compiled 1F1B schedule over "
+            f"{self.num_stages} stages, {self.micro_batches} "
+            "microbatches (clock-aligned TrainSchedule)", ranks=[0])
+
+    # ------------------------------------------------------------------
     # batch API (ref pipe/engine.py:244,320)
     # ------------------------------------------------------------------
     def _collect_full_batch(self, data_iter=None, batch=None):
@@ -158,6 +221,9 @@ class PipelineEngine(DeepSpeedEngine):
                 lambda x: np.asarray(x).reshape(
                     (m, np.asarray(x).shape[0] // m) +
                     np.asarray(x).shape[1:]), batch)
+            if getattr(self, "_use_1f1b", False):
+                stacked = _to_dict_batch(stacked)
+                self._ensure_interp(stacked)
         return super().train_batch(batch=stacked)
 
     def eval_batch(self, data_iter=None, batch=None):

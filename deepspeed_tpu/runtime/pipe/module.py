@@ -248,15 +248,29 @@ class PipelineModule:
 
     def save_state_dict(self, ckpt_dir, params):
         """Write one file per layer (plus one per tied-param group).
-        `params` is the engine param structure from `init_params`."""
+        `params` is the engine param structure from `init_params`.
+
+        ALL processes must call this (multi-host shardings require a
+        collective gather per layer — bounded host memory: one layer at
+        a time, like the reference's per-layer files); only process 0
+        writes."""
         import os
         from deepspeed_tpu.runtime.checkpoint import tree_to_entries
-        os.makedirs(ckpt_dir, exist_ok=True)
+        if jax.process_index() == 0:
+            os.makedirs(ckpt_dir, exist_ok=True)
+
+        def host(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                from jax.experimental import multihost_utils
+                return np.asarray(
+                    multihost_utils.process_allgather(leaf, tiled=True))
+            return np.asarray(jax.device_get(leaf))
 
         def write(path, tree):
-            arrays = {key: np.asarray(jax.device_get(leaf))
+            arrays = {key: host(leaf)
                       for key, leaf in tree_to_entries(tree)}
-            np.savez(path, **arrays)
+            if jax.process_index() == 0:
+                np.savez(path, **arrays)
 
         for idx_str, tree in params.get("layers", {}).items():
             write(self.ckpt_layer_path(ckpt_dir, int(idx_str)), tree)

@@ -22,15 +22,21 @@ is a *sharding annotation*:
            falls out of the same annotation mechanism.
 
 The policy below picks, per array, the largest dimension divisible by the
-data-axis size (GSPMD requires no padding bookkeeping — the reference's
-alignment/padding logic, `stage1.py:198-261`, has no analogue here).
-Leaves too small to shard stay replicated, mirroring the reference's
-handling of sub-partition remainders.
+data-axis size. Leaves with NO divisible dimension get *padded* on their
+largest free dimension up to the next dp multiple (the TPU-native form of
+the reference's sub-partition alignment padding, `stage1.py:198-261`):
+the engine stores master weights / optimizer moments in the padded
+("encoded") layout so they genuinely shard, and slices the padding off
+("decode") when writing back compute-dtype params or checkpoints.
+Tiny leaves (numel < 2*dp) stay replicated — the shard would be smaller
+than the bookkeeping.
 """
 
+import math
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -123,6 +129,85 @@ class ZeroShardingPolicy:
 
     def grad_accum_shardings(self, params):
         return self._named(self.grad_accum_pspecs(params))
+
+    # -- padding plan for non-divisible leaves ---------------------------
+    def pad_plan(self, params):
+        """{param_path_keystr: (dim, padded_size, true_size)} for every
+        leaf that has no data-divisible free dimension but is big enough
+        to be worth sharding. Empty dict when nothing needs padding (the
+        common case for power-of-two model dims at moderate dp)."""
+        plan = {}
+        if self.dp_size <= 1 or self.stage < 1:
+            return plan
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        spec_flat = None
+        if self.param_specs is not None:
+            spec_flat = jax.tree_util.tree_leaves(
+                self.param_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+        for i, (path, leaf) in enumerate(flat):
+            shape = np.shape(leaf)
+            if not shape or int(np.prod(shape)) < 2 * self.dp_size:
+                continue
+            tp = list(spec_flat[i]) if spec_flat is not None else []
+            tp += [None] * (len(shape) - len(tp))
+            free = [(d, s) for d, s in enumerate(shape) if tp[d] is None]
+            if not free:
+                continue
+            if any(s % self.dp_size == 0 and s >= self.dp_size
+                   for _, s in free):
+                continue  # leaf_data_spec will shard it unpadded
+            d, s = max(free, key=lambda t: t[1])
+            plan[jax.tree_util.keystr(path)] = (
+                d, math.ceil(s / self.dp_size) * self.dp_size, s)
+        return plan
+
+    @staticmethod
+    def _plan_entry(plan, keys, ks, suffix_match):
+        entry = plan.get(ks)
+        if entry is None and suffix_match:
+            for k in keys:  # longest suffix wins
+                if ks.endswith(k):
+                    return plan[k]
+        return entry
+
+    def _tree_apply_plan(self, tree, plan, fn, suffix_match):
+        """Apply fn(leaf, (dim, padded, true)) to leaves whose path
+        matches the plan. suffix_match: optimizer-state trees (mu/nu/...
+        reuse the param tree structure, so their keystr ENDS with the
+        param's keystr)."""
+        if not plan:
+            return tree
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        keys = sorted(plan, key=len, reverse=True)
+        leaves = []
+        for path, leaf in flat:
+            entry = self._plan_entry(plan, keys,
+                                     jax.tree_util.keystr(path),
+                                     suffix_match)
+            leaves.append(leaf if entry is None else fn(leaf, entry))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def encode(self, tree, plan, suffix_match=False):
+        """Pad plan leaves to their data-divisible shapes (with zeros —
+        grad norms and optimizer moments are unaffected)."""
+        def pad(leaf, entry):
+            d, padded, true = entry
+            if d >= leaf.ndim or leaf.shape[d] != true:
+                return leaf  # already padded, or not a moment-like leaf
+            pads = [(0, 0)] * leaf.ndim
+            pads[d] = (0, padded - true)
+            return jnp.pad(leaf, pads)
+        return self._tree_apply_plan(tree, plan, pad, suffix_match)
+
+    def decode(self, tree, plan, suffix_match=False):
+        """Slice padded leaves back to their true shapes."""
+        def unpad(leaf, entry):
+            d, padded, true = entry
+            if d >= leaf.ndim or leaf.shape[d] != padded:
+                return leaf
+            return jax.lax.slice_in_dim(leaf, 0, true, axis=d)
+        return self._tree_apply_plan(tree, plan, unpad, suffix_match)
 
     def opt_state_shardings(self, opt_state, params):
         """Optimizer state: leaves that mirror a param shape get that

@@ -40,7 +40,7 @@ def test_csr_add():
 def test_csr_mean_rows_matches_pmean():
     """Inside shard_map, the sparse gather-reduce must equal the dense
     pmean for row-sparse per-device grads."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
     rows, cols = 64, 16
     rng = np.random.default_rng(0)
@@ -59,10 +59,10 @@ def test_csr_mean_rows_matches_pmean():
 
     out_sparse = shard_map(
         sparse_fn, mesh=mesh, in_specs=P("data"), out_specs=P(),
-        check_rep=False)(stacked)
+        check_vma=False)(stacked)
     out_dense = shard_map(
         dense_fn, mesh=mesh, in_specs=P("data"), out_specs=P(),
-        check_rep=False)(stacked)
+        check_vma=False)(stacked)
     np.testing.assert_allclose(np.asarray(out_sparse),
                                np.asarray(out_dense), rtol=1e-6,
                                atol=1e-7)

@@ -1,0 +1,204 @@
+"""Compiled 1F1B pipeline execution for heterogeneous PipelineModules
+(VERDICT r1 #7; parity targets: ref `pipe/engine.py:1135-1161` schedule
+interpreter, `schedule.py:182-289` 1F1B, `schedule.py:243-247` buffer
+bound, `module.py:405-409` tied-grad reduction).
+
+Runs on the 8-device virtual CPU mesh (pipe=2 x data=4)."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.pipe.interp import (build_clock_tables,
+                                               num_pipe_buffers)
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+from deepspeed_tpu.runtime.pipe.schedule import TrainSchedule
+
+DIN, DMID, DOUT = 16, 32, 8
+
+
+def mse_loss(pred, labels):
+    return jnp.mean((pred.astype(jnp.float32) -
+                     labels.astype(jnp.float32)) ** 2)
+
+
+def hetero_module(num_stages):
+    """Deliberately heterogeneous: different widths per stage and a
+    plain-callable (paramless) layer in the chain."""
+    layers = [
+        LayerSpec(nn.Dense, DMID),
+        jnp.tanh,                       # paramless callable layer
+        LayerSpec(nn.Dense, DMID * 2),
+        LayerSpec(nn.Dense, DOUT),
+    ]
+    return PipelineModule(layers, num_stages=num_stages, loss_fn=mse_loss,
+                          partition_method="uniform")
+
+
+def make_engine(num_stages, pipe, data, gas, seed=0):
+    module = hetero_module(num_stages)
+    rng = np.random.RandomState(seed)
+    example = jnp.asarray(rng.randn(4, DIN), jnp.float32)
+    params = module.init_params(jax.random.PRNGKey(seed), example)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"pipe": pipe, "data": data, "model": 1},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params, config=cfg)
+    return engine
+
+
+def full_batch(gas, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8 * gas, DIN).astype(np.float32)
+    w = np.linspace(-1, 1, DIN * DOUT).reshape(DIN, DOUT).astype(np.float32)
+    return {"x": x, "y": x @ w}
+
+
+# ----------------------------------------------------------------------
+# clock tables
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("m,S", [(4, 2), (8, 4), (2, 2), (1, 2), (6, 3)])
+def test_clock_tables_complete_and_ordered(m, S):
+    t = build_clock_tables(m, S)
+    fwd, bwd = t["fwd_mb"], t["bwd_mb"]
+    for s in range(S):
+        # every microbatch forwards and backwards exactly once per stage
+        assert sorted(fwd[:, s][fwd[:, s] >= 0].tolist()) == list(range(m))
+        assert sorted(bwd[:, s][bwd[:, s] >= 0].tolist()) == list(range(m))
+    for mb in range(m):
+        for s in range(S - 1):
+            f0 = int(np.where(fwd[:, s] == mb)[0][0])
+            f1 = int(np.where(fwd[:, s + 1] == mb)[0][0])
+            assert f0 < f1, "activation must flow down the pipeline"
+            b1 = int(np.where(bwd[:, s + 1] == mb)[0][0])
+            b0 = int(np.where(bwd[:, s] == mb)[0][0])
+            assert b1 < b0, "cotangent must flow up the pipeline"
+        # a stage's backward needs its own forward first
+        for s in range(S):
+            f = int(np.where(fwd[:, s] == mb)[0][0])
+            b = int(np.where(bwd[:, s] == mb)[0][0])
+            assert f < b
+
+
+def test_clock_tables_overlap_stages():
+    """The point of 1F1B: in steady state different stages work on
+    different microbatches in the SAME tick."""
+    t = build_clock_tables(8, 4)
+    busy = (t["fwd_mb"] >= 0) | (t["bwd_mb"] >= 0)
+    assert (busy.sum(axis=1) >= 2).any(), "no tick overlaps stages"
+    # total ticks must beat the sequential chain's m*S fwd + m*S bwd
+    assert t["num_ticks"] < 2 * 8 * 4
+
+
+def test_live_buffer_bound_matches_schedule():
+    """In-flight forwards per stage (forwarded but not yet backwarded)
+    must never exceed TrainSchedule.num_pipe_buffers (ref
+    schedule.py:243-247) — the 1F1B memory claim."""
+    for m, S in [(8, 2), (8, 4), (4, 4)]:
+        t = build_clock_tables(m, S)
+        for s in range(S):
+            bound = TrainSchedule(m, S, s).num_pipe_buffers()
+            live = 0
+            for tick in range(t["num_ticks"]):
+                if t["fwd_mb"][tick, s] >= 0:
+                    live += 1
+                if t["bwd_mb"][tick, s] >= 0:
+                    live -= 1
+                assert live <= bound, (m, S, s, tick, live, bound)
+        assert num_pipe_buffers(m, S) == max(
+            TrainSchedule(m, S, s).num_pipe_buffers() for s in range(S))
+
+
+# ----------------------------------------------------------------------
+# end-to-end equivalence
+# ----------------------------------------------------------------------
+def test_1f1b_matches_sequential_chain():
+    """Pipelined execution is a pure schedule change: losses and params
+    must match the pipe=1 sequential chain step for step."""
+    def run(pipe, data):
+        engine = make_engine(num_stages=pipe, pipe=pipe, data=data, gas=4)
+        losses = []
+        for i in range(5):
+            loss = engine.train_batch(batch=full_batch(4, seed=i % 3))
+            losses.append(float(jax.device_get(loss)))
+        return losses, jax.device_get(engine.fp32_params)
+
+    losses_seq, params_seq = run(pipe=1, data=8)
+    losses_pp, params_pp = run(pipe=2, data=4)
+    np.testing.assert_allclose(losses_pp, losses_seq, rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(params_seq),
+                    jax.tree_util.tree_leaves(params_pp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_mode_selected_and_loss_decreases():
+    engine = make_engine(num_stages=2, pipe=2, data=4, gas=4)
+    assert engine._use_1f1b
+    losses = []
+    for i in range(12):
+        loss = engine.train_batch(batch=full_batch(4, seed=i % 3))
+        losses.append(float(jax.device_get(loss)))
+    assert losses[-1] < losses[0] * 0.6, losses
+
+
+def test_1f1b_tied_layers_sum_grads():
+    """TiedLayerSpec shared across stages: the pipe-axis psum must SUM
+    the tied grads (ReduceTiedGrads, ref module.py:405-409) — verified
+    against the sequential chain where autodiff sums them."""
+    class Emb(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            w = self.param("embedding", nn.initializers.normal(0.1),
+                           (DIN, DIN))
+            return x @ w
+
+    def tied_module(num_stages):
+        layers = [
+            TiedLayerSpec("emb", Emb, tied_weight_attr="embedding"),
+            LayerSpec(nn.Dense, DIN),
+            TiedLayerSpec("emb", Emb, tied_weight_attr="embedding",
+                          forward_fn=lambda p, x: x @ p["embedding"].T),
+        ]
+        return PipelineModule(layers, num_stages=num_stages,
+                              loss_fn=lambda pred, y: jnp.mean(
+                                  (pred - y.astype(pred.dtype)) ** 2),
+                              partition_method="uniform")
+
+    def run(pipe, data):
+        module = tied_module(pipe)
+        rng = np.random.RandomState(0)
+        example = jnp.asarray(rng.randn(4, DIN), jnp.float32)
+        params = module.init_params(jax.random.PRNGKey(0), example)
+        cfg = {
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 4,
+            "steps_per_print": 1000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "mesh": {"pipe": pipe, "data": data, "model": 1},
+        }
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=module, model_parameters=params, config=cfg)
+        losses = []
+        for i in range(4):
+            x = np.random.RandomState(i).randn(32, DIN).astype(np.float32)
+            loss = engine.train_batch(batch={"x": x, "y": x})
+            losses.append(float(jax.device_get(loss)))
+        return losses, jax.device_get(engine.fp32_params)
+
+    losses_seq, params_seq = run(pipe=1, data=8)
+    losses_pp, params_pp = run(pipe=2, data=4)
+    np.testing.assert_allclose(losses_pp, losses_seq, rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(params_seq),
+                    jax.tree_util.tree_leaves(params_pp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
