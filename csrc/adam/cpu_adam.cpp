@@ -43,6 +43,52 @@ std::unordered_map<int, AdamState>& registry() {
     return r;
 }
 
+// Shared update loop: one fused AdamW pass over [0, n) at an explicit
+// bias-correction step.
+void adam_apply(const AdamState& st, int64_t step, int64_t n,
+                float* params, const float* grads, float* exp_avg,
+                float* exp_avg_sq, float lr_override) {
+    // negative = no override; 0.0 is a legitimate scheduled lr
+    const float lr = lr_override >= 0.0f ? lr_override : st.lr;
+    const float b1 = st.beta1;
+    const float b2 = st.beta2;
+    const float eps = st.eps;
+    const float wd = st.weight_decay;
+    const bool adamw = st.adamw_mode;
+
+    const float bias1 = 1.0f - std::pow(b1, (float)step);
+    const float bias2 = 1.0f - std::pow(b2, (float)step);
+    const float step_size = lr / bias1;
+    const float inv_sqrt_bias2 = 1.0f / std::sqrt(bias2);
+
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        float g = grads[i];
+        float p = params[i];
+        if (!adamw && wd != 0.0f) g += wd * p;  // L2 (classic Adam)
+        float m = b1 * exp_avg[i] + (1.0f - b1) * g;
+        float v = b2 * exp_avg_sq[i] + (1.0f - b2) * g * g;
+        exp_avg[i] = m;
+        exp_avg_sq[i] = v;
+        float denom = std::sqrt(v) * inv_sqrt_bias2 + eps;
+        // decoupled decay scales with lr, NOT the bias-corrected step
+        // size (optax.adamw / torch.AdamW semantics)
+        float decay = (adamw && wd != 0.0f) ? lr * wd * p : 0.0f;
+        params[i] = p - step_size * (m / denom) - decay;
+    }
+}
+
+void bf16_cast(const float* params, uint16_t* params_bf16, int64_t n) {
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+        uint32_t bits;
+        std::memcpy(&bits, &params[i], sizeof(bits));
+        // round-to-nearest-even bf16 truncation
+        uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
+        params_bf16[i] = (uint16_t)((bits + rounding) >> 16);
+    }
+}
+
 }  // namespace
 
 extern "C" {
@@ -76,36 +122,28 @@ int64_t ds_adam_step(int optimizer_id, int64_t n, float* params,
     if (it == registry().end()) return -1;
     AdamState& st = it->second;
     st.step += 1;
-
-    // negative = no override; 0.0 is a legitimate scheduled lr
-    const float lr = lr_override >= 0.0f ? lr_override : st.lr;
-    const float b1 = st.beta1;
-    const float b2 = st.beta2;
-    const float eps = st.eps;
-    const float wd = st.weight_decay;
-    const bool adamw = st.adamw_mode;
-
-    const float bias1 = 1.0f - std::pow(b1, (float)st.step);
-    const float bias2 = 1.0f - std::pow(b2, (float)st.step);
-    const float step_size = lr / bias1;
-    const float inv_sqrt_bias2 = 1.0f / std::sqrt(bias2);
-
-#pragma omp parallel for schedule(static)
-    for (int64_t i = 0; i < n; ++i) {
-        float g = grads[i];
-        float p = params[i];
-        if (!adamw && wd != 0.0f) g += wd * p;  // L2 (classic Adam)
-        float m = b1 * exp_avg[i] + (1.0f - b1) * g;
-        float v = b2 * exp_avg_sq[i] + (1.0f - b2) * g * g;
-        exp_avg[i] = m;
-        exp_avg_sq[i] = v;
-        float denom = std::sqrt(v) * inv_sqrt_bias2 + eps;
-        // decoupled decay scales with lr, NOT the bias-corrected step
-        // size (optax.adamw / torch.AdamW semantics)
-        float decay = (adamw && wd != 0.0f) ? lr * wd * p : 0.0f;
-        params[i] = p - step_size * (m / denom) - decay;
-    }
+    adam_apply(st, st.step, n, params, grads, exp_avg, exp_avg_sq,
+               lr_override);
     return st.step;
+}
+
+// Chunked step with an EXPLICIT step count: the offload driver
+// pipelines D2H / compute / H2D per chunk (the stream overlap of ref
+// stage2.py:743-941) while every chunk shares one bias-correction
+// step. Does not advance the internal counter — the driver calls
+// ds_adam_set_step once per optimizer step. Pointers address the
+// chunk; moments are the same slice of the full buffers.
+int64_t ds_adam_step_chunk(int optimizer_id, int64_t step, int64_t n,
+                           float* params, const float* grads,
+                           float* exp_avg, float* exp_avg_sq,
+                           uint16_t* params_bf16 /* may be null */,
+                           float lr_override) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    adam_apply(it->second, step, n, params, grads, exp_avg, exp_avg_sq,
+               lr_override);
+    if (params_bf16 != nullptr) bf16_cast(params, params_bf16, n);
+    return step;
 }
 
 // Step + cast updated params to bf16 (uint16 storage) in one pass —
@@ -117,14 +155,7 @@ int64_t ds_adam_step_copy_bf16(int optimizer_id, int64_t n, float* params,
     int64_t step = ds_adam_step(optimizer_id, n, params, grads, exp_avg,
                                 exp_avg_sq, lr_override);
     if (step < 0) return step;
-#pragma omp parallel for schedule(static)
-    for (int64_t i = 0; i < n; ++i) {
-        uint32_t bits;
-        std::memcpy(&bits, &params[i], sizeof(bits));
-        // round-to-nearest-even bf16 truncation
-        uint32_t rounding = 0x7FFF + ((bits >> 16) & 1);
-        params_bf16[i] = (uint16_t)((bits + rounding) >> 16);
-    }
+    bf16_cast(params, params_bf16, n);
     return step;
 }
 

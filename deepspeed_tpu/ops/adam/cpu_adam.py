@@ -94,21 +94,62 @@ class DeepSpeedCPUAdam:
             self.step_count = int(step)
             return params
 
-        # numpy fallback (identical math)
+        # numpy fallback: one full-range chunk (identical math)
         self.step_count += 1
+        return self.step_chunk(0, self.num_elements, params, grads,
+                               lr=lr, params_bf16_out=params_bf16_out)
+
+    def begin_step(self):
+        """Open a chunked optimizer step: advances the bias-correction
+        counter ONCE; subsequent step_chunk calls share it. Pairs with
+        the offload driver's D2H/compute/H2D pipelining."""
+        self.step_count += 1
+        if self._lib is not None:
+            self._lib.ds_adam_set_step(self.opt_id, self.step_count)
+
+    def step_chunk(self, lo, hi, params, grads, lr=None,
+                   params_bf16_out=None):
+        """AdamW over elements [lo, hi) at the step opened by
+        begin_step. `params`/`grads` are the CHUNK arrays (len hi-lo);
+        moments are sliced internally."""
+        import ctypes
+        assert self.step_count >= 1, \
+            "step_chunk requires begin_step() first (step 0 would " \
+            "divide by a zero bias correction)"
+        assert params.dtype == np.float32 and grads.dtype == np.float32
+        assert params.size == hi - lo == grads.size
+        lr_eff = -1.0 if lr is None else float(lr)
+        m = self.exp_avg[lo:hi]
+        v = self.exp_avg_sq[lo:hi]
+
+        if self._lib is not None:
+            f32p = ctypes.POINTER(ctypes.c_float)
+            u16p = ctypes.POINTER(ctypes.c_uint16)
+            bf16 = params_bf16_out.ctypes.data_as(u16p) \
+                if params_bf16_out is not None else \
+                ctypes.cast(None, u16p)
+            self._lib.ds_adam_step_chunk(
+                self.opt_id, self.step_count, hi - lo,
+                params.ctypes.data_as(f32p),
+                grads.ctypes.data_as(f32p),
+                m.ctypes.data_as(f32p), v.ctypes.data_as(f32p),
+                bf16, lr_eff)
+            return params
+
+        # numpy fallback (identical math, explicit step)
         lr_v = self.lr if lr is None else lr
         b1, b2 = self.betas
         g = grads
         if not self.adamw_mode and self.weight_decay:
             g = g + self.weight_decay * params
-        self.exp_avg *= b1
-        self.exp_avg += (1 - b1) * g
-        self.exp_avg_sq *= b2
-        self.exp_avg_sq += (1 - b2) * g * g
+        m *= b1
+        m += (1 - b1) * g
+        v *= b2
+        v += (1 - b2) * g * g
         bias1 = 1 - b1 ** self.step_count
         bias2 = 1 - b2 ** self.step_count
-        denom = np.sqrt(self.exp_avg_sq) / np.sqrt(bias2) + self.eps
-        update = (lr_v / bias1) * (self.exp_avg / denom)
+        denom = np.sqrt(v) / np.sqrt(bias2) + self.eps
+        update = (lr_v / bias1) * (m / denom)
         if self.adamw_mode and self.weight_decay:
             update = update + lr_v * self.weight_decay * params
         params -= update

@@ -1,18 +1,22 @@
-"""Block-sparse flash attention: layout-gated Pallas kernels.
+"""Block-sparse flash attention: index-compacted Pallas kernels.
 
 TPU replacement for the reference's Triton SDD/DSD/DDS matmul + sparse
 softmax pipeline (`ops/sparse_attention/matmul.py:16-750`,
-`softmax.py:17-304`, `trsrc/*.tr`). Where Triton gathers irregular block
-lists through lookup tables (`sdd_segment`, `csrc/sparse_attention/
-utils.cpp:117`), the TPU kernel keeps the dense flash-attention grid and
-*predicates* each K-block tile on the boolean layout: invisible blocks
-skip their matmuls entirely (the MXU sees only visible tiles), so FLOPs
-scale with layout density while the memory-access pattern stays the
-regular streaming one the hardware wants (SURVEY §7: irregular gathers
-are TPU-hostile; predicated-dense is the splash-attention-style answer).
+`softmax.py:17-304`, `trsrc/*.tr`). The reference compiles per-layout
+lookup tables (`sdd_segment`, `csrc/sparse_attention/utils.cpp:117`)
+that enumerate the visible blocks; the TPU kernels do the same thing
+with scalar-prefetch index tables: for each query row-block the table
+lists exactly the visible key blocks (causality already folded in at
+block granularity), and the grid's inner dimension runs over THAT list
+— `kmax` steps instead of `nq`. Work therefore scales with layout
+density (a 16k-context window layout with ~6 visible blocks per row
+runs a 128x6 grid, not 128x128), while every step is still one dense
+128x128 MXU tile from a regular streaming access pattern.
 
 The layout block size doubles as the kernel tile size (128 = one MXU
 tile; the reference's 16-wide Triton blocks would starve the MXU).
+Tables dedupe identical per-head layouts (the default for every shipped
+SparsityConfig) so the SMEM footprint is ~U*nq*kmax*4 bytes, a few KB.
 """
 
 import functools
@@ -27,189 +31,231 @@ from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF, _on_tpu,
                                                            dense_attention)
 
 
-def _causal_visible(qi, ki, block):
-    return ki * block <= qi * block + block - 1
+# ----------------------------------------------------------------------
+# layout -> visible-block index tables
+# ----------------------------------------------------------------------
+def _build_tables(layout, causal):
+    """Concrete [H, nq, nk] layout -> scalar-prefetch tables:
+
+      head_map [H]          head -> unique-layout index u
+      kidx [U*nq*kmax]      visible key blocks per query row (padded)
+      kcnt [U*nq]           count of visible key blocks per query row
+      qidx [U*nq*qmax]      visible query blocks per key column (padded)
+      qcnt [U*nq]           count per key column
+
+    Causality is folded in at block granularity (ki <= qi), so the
+    kernels iterate ONLY over genuinely visible tiles — the TPU analog
+    of the reference's sdd_segment lookup tables. Padding repeats index
+    0; padded steps are skipped by the count predicate."""
+    lay = np.asarray(layout, np.int32)
+    unique, inverse = np.unique(lay, axis=0, return_inverse=True)
+    U, nq, nk = unique.shape
+    vis = unique != 0
+    if causal:
+        vis = vis & np.tril(np.ones((nq, nk), bool))[None]
+
+    kcnt = vis.sum(axis=2).astype(np.int32)               # [U, nq]
+    qcnt = vis.sum(axis=1).astype(np.int32)               # [U, nk]
+    kmax = max(1, int(kcnt.max()))
+    qmax = max(1, int(qcnt.max()))
+    kidx = np.zeros((U, nq, kmax), np.int32)
+    qidx = np.zeros((U, nk, qmax), np.int32)
+    for u in range(U):
+        for qi in range(nq):
+            cols = np.where(vis[u, qi])[0]
+            kidx[u, qi, :len(cols)] = cols
+        for ki in range(nk):
+            rows = np.where(vis[u, :, ki])[0]
+            qidx[u, ki, :len(rows)] = rows
+    # head-group size: the largest power of two (<=8) dividing H whose
+    # groups are layout-uniform — grouped heads ride one grid step
+    hm = inverse.reshape(-1)
+    H = hm.size
+    g = 1
+    for cand in (8, 4, 2):
+        if H % cand == 0 and \
+                (hm.reshape(H // cand, cand) ==
+                 hm.reshape(H // cand, cand)[:, :1]).all():
+            g = cand
+            break
+    return (jnp.asarray(hm, jnp.int32),
+            jnp.asarray(kidx.reshape(-1)), jnp.asarray(kcnt.reshape(-1)),
+            jnp.asarray(qidx.reshape(-1)), jnp.asarray(qcnt.reshape(-1)),
+            kmax, qmax, g)
 
 
-def _bs_fwd_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   m_scr, l_scr, acc_scr, *, sm_scale, causal, block,
-                   num_heads):
+def _row(hm_ref, bhi, qi, nq, num_heads):
+    u = hm_ref[jax.lax.rem(bhi, num_heads)]
+    return u * nq + qi
+
+
+# ----------------------------------------------------------------------
+# kernels (grid inner dim = visible-block list position)
+# ----------------------------------------------------------------------
+def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
+                   o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
+                   causal, block, num_heads, nq, kmax, g):
+    # blocks carry G heads per grid step (legal because grouped heads
+    # share one layout row): fewer, fatter steps amortize the per-step
+    # grid/DMA overhead that starves 128-row single-head tiles
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    h_idx = jax.lax.rem(pl.program_id(0), num_heads)
+    st = pl.program_id(2)
+    row = _row(hm_ref, pl.program_id(0) * g, qi, nq, num_heads)
 
-    @pl.when(ki == 0)
+    @pl.when(st == 0)
     def _():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    nq_l = pl.num_programs(1)
-    lay_h = head_map_ref[h_idx]
-    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
-    if causal:
-        visible = jnp.logical_and(visible,
-                                  _causal_visible(qi, ki, block))
-
-    @pl.when(visible)
+    @pl.when(st < kcnt_ref[row])
     def _():
-        q = q_ref[0]
-        k = k_ref[0]
+        ki = kidx_ref[row * kmax + st]
+        q = q_ref[...]
+        k = k_ref[...]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale   # [G, B, B]
         if causal:
             rows = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             cols = ki * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
 
-        m_prev = m_scr[:, :1]
-        l_prev = l_scr[:, :1]
+        m_prev = m_scr[:, :, :1]
+        l_prev = l_scr[:, :, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[0]
+        v = v_ref[...]
         pv = jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:, :1] = m_new
-        l_scr[:, :1] = l_new
+        m_scr[:, :, :1] = m_new
+        l_scr[:, :, :1] = l_new
 
-    @pl.when(ki == nk - 1)
+    @pl.when(st == kmax - 1)
     def _():
-        l = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_scr[:, :1] + jnp.log(l)
+        l = jnp.maximum(l_scr[:, :, :1], 1e-30)
+        o_ref[...] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
 
 
-def _bs_bwd_dkv_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                       delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                       sm_scale, causal, block, num_heads):
+def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, q_ref, k_ref, v_ref,
+                       do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                       dk_scr, dv_scr, *, sm_scale, causal, block,
+                       num_heads, nq, qmax, g):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
-    h_idx = jax.lax.rem(pl.program_id(0), num_heads)
+    st = pl.program_id(2)
+    row = _row(hm_ref, pl.program_id(0) * g, ki, nq, num_heads)
 
-    @pl.when(qi == 0)
+    @pl.when(st == 0)
     def _():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    nq_l = pl.num_programs(1)
-    lay_h = head_map_ref[h_idx]
-    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
-    if causal:
-        visible = jnp.logical_and(visible,
-                                  _causal_visible(qi, ki, block))
-
-    @pl.when(visible)
+    @pl.when(st < qcnt_ref[row])
     def _():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        qi = qidx_ref[row * qmax + st]
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * sm_scale
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale   # [G, Bq, Bk]
         if causal:
             rows = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             cols = ki * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
         p = jnp.exp(s - lse)
         dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dk_scr[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((1,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(st == qmax - 1)
     def _():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dk_ref[...] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bs_bwd_dq_kernel(head_map_ref, layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                      delta_ref, dq_ref, dq_scr, *, sm_scale, causal,
-                      block, num_heads):
+def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
+                      do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+                      sm_scale, causal, block, num_heads, nq, kmax, g):
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
-    h_idx = jax.lax.rem(pl.program_id(0), num_heads)
+    st = pl.program_id(2)
+    row = _row(hm_ref, pl.program_id(0) * g, qi, nq, num_heads)
 
-    @pl.when(ki == 0)
+    @pl.when(st == 0)
     def _():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    nq_l = pl.num_programs(1)
-    lay_h = head_map_ref[h_idx]
-    visible = layout_ref[(lay_h * nq_l + qi) * nq_l + ki] != 0
-    if causal:
-        visible = jnp.logical_and(visible,
-                                  _causal_visible(qi, ki, block))
-
-    @pl.when(visible)
+    @pl.when(st < kcnt_ref[row])
     def _():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        ki = kidx_ref[row * kmax + st]
+        q = q_ref[...]
+        k = k_ref[...]
+        v = v_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[...]
+        delta = delta_ref[...]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+            q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
             rows = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             cols = ki * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = jnp.where((rows >= cols)[None], s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
+            do, v, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
         dq_scr[:] += jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
 
-    @pl.when(ki == nk - 1)
+    @pl.when(st == kmax - 1)
     def _():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[...] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dedup_layout(layout):
-    """[H, nq, nk] concrete layout -> (head_map [H], flat unique
-    layouts) for SMEM scalar prefetch. Heads sharing a layout (the
-    default for every shipped SparsityConfig:
-    different_layout_per_head=False) collapse to ONE stored copy — at
-    16k context a per-head table would be H*nq*nk*4 = 4 MB of SMEM,
-    past the hardware limit, while the deduped table is
-    nq*nk*4 = 64 KB. Must be called on concrete (numpy) layouts, so it
-    runs once at the public entry point and the deduped arrays thread
-    through the custom-VJP residuals."""
-    lay = np.asarray(layout, np.int32)
-    unique, inverse = np.unique(lay, axis=0, return_inverse=True)
-    return (jnp.asarray(inverse.reshape(-1), jnp.int32),
-            jnp.asarray(unique, jnp.int32).reshape(-1))
+# ----------------------------------------------------------------------
+# pallas_call plumbing
+# ----------------------------------------------------------------------
+def _k_lookup(nq, kmax, num_heads, g):
+    """BlockSpec index fn for k/v: the key block comes from the table."""
+    def idx(grp, qi, st, hm_ref, kidx_ref, kcnt_ref):
+        row = _row(hm_ref, grp * g, qi, nq, num_heads)
+        return (grp, kidx_ref[row * kmax + st], 0)
+    return idx
 
 
-def _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
-            interpret):
+def _q_lookup(nq, qmax, num_heads, g):
+    def idx(grp, ki, st, hm_ref, qidx_ref, qcnt_ref):
+        row = _row(hm_ref, grp * g, ki, nq, num_heads)
+        return (grp, qidx_ref[row * qmax + st], 0)
+    return idx
+
+
+def _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal, block,
+            interpret, kmax, g):
     b, t, h, d = q.shape
     bh = b * h
     nq = t // block
@@ -218,23 +264,26 @@ def _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
 
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale,
-                               causal=causal, block=block, num_heads=h)
+                               causal=causal, block=block, num_heads=h,
+                               nq=nq, kmax=kmax, g=g)
+    fixed = lambda grp, qi, st, *_: (grp, qi, 0)
+    kv = _k_lookup(nq, kmax, h, g)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, nq),
+        num_scalar_prefetch=3,
+        grid=(bh // g, nq, kmax),
         in_specs=[
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, ki, 0)),
+            pl.BlockSpec((g, block, d), fixed),
+            pl.BlockSpec((g, block, d), kv),
+            pl.BlockSpec((g, block, d), kv),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, 1), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
+            pl.BlockSpec((g, block, d), fixed),
+            pl.BlockSpec((g, block, 1), fixed),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block, 128), jnp.float32),
-            pltpu.VMEM((block, 128), jnp.float32),
-            pltpu.VMEM((block, d), jnp.float32),
+            pltpu.VMEM((g, block, 128), jnp.float32),
+            pltpu.VMEM((g, block, 128), jnp.float32),
+            pltpu.VMEM((g, block, d), jnp.float32),
         ],
     )
     out, lse = pl.pallas_call(
@@ -245,12 +294,13 @@ def _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(head_map, lay_flat, to_bht(q), to_bht(k), to_bht(v))
+    )(head_map, kidx, kcnt, to_bht(q), to_bht(k), to_bht(v))
     return out, lse
 
 
-def _bs_bwd(sm_scale, causal, block, interpret, res, g):
-    q, k, v, out, lse, head_map, lay_flat = res
+def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, res,
+            g):
+    q, k, v, out, lse, head_map, kidx, kcnt, qidx, qcnt = res
     b, t, h, d = q.shape
     bh = b * h
     nq = t // block
@@ -266,26 +316,30 @@ def _bs_bwd(sm_scale, causal, block, interpret, res, g):
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
+    fixed1 = lambda grp, ki, st, *_: (grp, ki, 0)
+    qv = _q_lookup(nq, qmax, h, g_grp)
     dkv_kernel = functools.partial(_bs_bwd_dkv_kernel, sm_scale=sm_scale,
-                                   causal=causal, block=block, num_heads=h)
+                                   causal=causal, block=block,
+                                   num_heads=h, nq=nq, qmax=qmax,
+                                   g=g_grp)
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, nq),
+        num_scalar_prefetch=3,
+        grid=(bh // g_grp, nq, qmax),
         in_specs=[
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, 1), lambda bhi, ki, qi, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, 1), lambda bhi, ki, qi, *_: (bhi, qi, 0)),
+            pl.BlockSpec((g_grp, block, d), qv),      # q from table
+            pl.BlockSpec((g_grp, block, d), fixed1),  # k at ki
+            pl.BlockSpec((g_grp, block, d), fixed1),  # v at ki
+            pl.BlockSpec((g_grp, block, d), qv),      # do from table
+            pl.BlockSpec((g_grp, block, 1), qv),      # lse from table
+            pl.BlockSpec((g_grp, block, 1), qv),      # delta from table
         ],
         out_specs=[
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, ki, qi, *_: (bhi, ki, 0)),
+            pl.BlockSpec((g_grp, block, d), fixed1),
+            pl.BlockSpec((g_grp, block, d), fixed1),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block, d), jnp.float32),
-            pltpu.VMEM((block, d), jnp.float32),
+            pltpu.VMEM((g_grp, block, d), jnp.float32),
+            pltpu.VMEM((g_grp, block, d), jnp.float32),
         ],
     )
     dk, dv = pl.pallas_call(
@@ -296,54 +350,66 @@ def _bs_bwd(sm_scale, causal, block, interpret, res, g):
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(head_map, lay_flat, qt, kt, vt, dot_, lse, delta)
+    )(head_map, qidx, qcnt, qt, kt, vt, dot_, lse, delta)
 
+    fixed = lambda grp, qi, st, *_: (grp, qi, 0)
+    kv = _k_lookup(nq, kmax, h, g_grp)
     dq_kernel = functools.partial(_bs_bwd_dq_kernel, sm_scale=sm_scale,
-                                  causal=causal, block=block, num_heads=h)
+                                  causal=causal, block=block,
+                                  num_heads=h, nq=nq, kmax=kmax,
+                                  g=g_grp)
     dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, nq, nq),
+        num_scalar_prefetch=3,
+        grid=(bh // g_grp, nq, kmax),
         in_specs=[
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, ki, 0)),
-            pl.BlockSpec((1, block, d), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, 1), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-            pl.BlockSpec((1, block, 1), lambda bhi, qi, ki, *_: (bhi, qi, 0)),
+            pl.BlockSpec((g_grp, block, d), fixed),
+            pl.BlockSpec((g_grp, block, d), kv),
+            pl.BlockSpec((g_grp, block, d), kv),
+            pl.BlockSpec((g_grp, block, d), fixed),
+            pl.BlockSpec((g_grp, block, 1), fixed),
+            pl.BlockSpec((g_grp, block, 1), fixed),
         ],
-        out_specs=pl.BlockSpec((1, block, d),
-                               lambda bhi, qi, ki, *_: (bhi, qi, 0)),
-        scratch_shapes=[pltpu.VMEM((block, d), jnp.float32)],
+        out_specs=pl.BlockSpec((g_grp, block, d), fixed),
+        scratch_shapes=[pltpu.VMEM((g_grp, block, d), jnp.float32)],
     )
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(head_map, lay_flat, qt, kt, vt, dot_, lse, delta)
+    )(head_map, kidx, kcnt, qt, kt, vt, dot_, lse, delta)
 
-    return from_bht(dq), from_bht(dk), from_bht(dv), None, None
+    return (from_bht(dq), from_bht(dk), from_bht(dv),
+            None, None, None, None, None)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _bs_flash(q, k, v, head_map, lay_flat, sm_scale, causal, block,
-              interpret):
-    out, _ = _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal,
-                     block, interpret)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14))
+def _bs_flash(q, k, v, head_map, kidx, kcnt, qidx, qcnt, sm_scale,
+              causal, block, interpret, kmax, qmax, g):
+    out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal,
+                     block, interpret, kmax, g)
     b, t, h, d = q.shape
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _bs_flash_fwd(q, k, v, head_map, lay_flat, sm_scale, causal, block,
-                  interpret):
-    out, lse = _bs_fwd(q, k, v, head_map, lay_flat, sm_scale, causal,
-                       block, interpret)
+def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, qidx, qcnt, sm_scale,
+                  causal, block, interpret, kmax, qmax, g):
+    out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal,
+                       block, interpret, kmax, g)
     b, t, h, d = q.shape
     out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return out_bthd, (q, k, v, out_bthd, lse, head_map, lay_flat)
+    return out_bthd, (q, k, v, out_bthd, lse, head_map, kidx, kcnt,
+                      qidx, qcnt)
 
 
-_bs_flash.defvjp(_bs_flash_fwd, _bs_bwd)
+def _bs_flash_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp,
+                  res, g):
+    return _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax,
+                   g_grp, res, g)
+
+
+_bs_flash.defvjp(_bs_flash_fwd, _bs_flash_bwd)
 
 
 def layout_to_dense_mask(layout, seq_len, block):
@@ -363,9 +429,9 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
     if isinstance(layout, jax.core.Tracer):
         raise ValueError(
             "block_sparse_attention requires a CONCRETE layout (it is "
-            "deduplicated host-side for SMEM prefetch); build the "
-            "layout outside jit — SparsityConfig.make_layout returns "
-            "numpy and layouts are static per (config, seq_len)")
+            "compiled into visible-block index tables host-side); build "
+            "the layout outside jit — SparsityConfig.make_layout "
+            "returns numpy and layouts are static per (config, seq_len)")
     layout = np.asarray(layout)
     assert layout.shape == (h, t // block, t // block), \
         (layout.shape, (h, t // block, t // block))
@@ -382,10 +448,16 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         sm_scale = 1.0 / np.sqrt(d)
     if interpret is None:
         interpret = not _on_tpu()
-    head_map, lay_flat = _dedup_layout(layout)
-    return _bs_flash(q, k, v, head_map, lay_flat,
+    head_map, kidx, kcnt, qidx, qcnt, kmax, qmax, g = _build_tables(
+        layout, causal)
+    assert h % g == 0 and (b * h) % g == 0  # _build_tables guarantees
+    # VMEM tile budget: the f32 score tile is g*block*block*4 bytes;
+    # keep g*block <= 2048 (16 MB VMEM, double-buffered operands)
+    while g > 1 and g * block > 2048:
+        g //= 2
+    return _bs_flash(q, k, v, head_map, kidx, kcnt, qidx, qcnt,
                      float(sm_scale), bool(causal), int(block),
-                     bool(interpret))
+                     bool(interpret), kmax, qmax, g)
 
 
 def block_sparse_attention_dense_fallback(q, k, v, layout, block,

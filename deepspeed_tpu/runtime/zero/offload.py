@@ -58,6 +58,19 @@ class ZeroOffloadMixin:
             f"ZeRO-Offload: {flat.size/1e6:.1f}M fp32 masters + moments "
             f"on host (native cpu_adam={self._host_adam.native})", ranks=[0])
 
+    # elements per transfer chunk; 4 MB of fp32 — big enough to
+    # amortize dispatch, small enough that D2H(i+1) / CPU-Adam(i) /
+    # H2D(i-1) genuinely overlap
+    _OFFLOAD_CHUNK_ELEMS = 1 << 20
+    _OFFLOAD_MAX_CHUNKS = 8
+
+    def _offload_bounds(self, n):
+        k = max(1, min(self._OFFLOAD_MAX_CHUNKS,
+                       n // self._OFFLOAD_CHUNK_ELEMS))
+        edges = np.linspace(0, n, k + 1).astype(np.int64)
+        return [(int(edges[i]), int(edges[i + 1])) for i in range(k)
+                if edges[i + 1] > edges[i]]
+
     def _build_offload_fns(self):
         """Jitted halves of the offload step."""
         clip = self.gradient_clipping()
@@ -75,8 +88,9 @@ class ZeroOffloadMixin:
 
         self._offload_grad_tail_jit = jax.jit(grad_tail)
 
-        def rebuild_params(flat):
-            # flat (compute dtype or fp32) -> param tree with shardings
+        def rebuild_params(chunks):
+            # chunk tuple (compute dtype or fp32) -> param tree
+            flat = jnp.concatenate([c.reshape(-1) for c in chunks])
             tree = self._offload_unravel(flat.astype(jnp.float32))
             tree = jax.tree_util.tree_map(
                 lambda x: x.astype(self.compute_dtype), tree)
@@ -109,21 +123,36 @@ class ZeroOffloadMixin:
                 skipped=self.state.skipped + 1)
             return True
 
-        grads_np = np.asarray(jax.device_get(flat), dtype=np.float32)
-        if self.compute_dtype == jnp.bfloat16:
-            # fused native step + bf16 downcast in one pass
-            bf16_out = np.empty(grads_np.size, np.uint16)
-            self._host_adam.step(self._host_master, grads_np,
-                                 lr=lr if lr is not None else None,
-                                 params_bf16_out=bf16_out)
-            flat_dev = jnp.asarray(bf16_out).view(jnp.bfloat16)
-        else:
-            # fp16/fp32 compute: push fp32 masters, cast on device (a
-            # bf16 round-trip would truncate fp16's 11-bit mantissa)
-            self._host_adam.step(self._host_master, grads_np,
-                                 lr=lr if lr is not None else None)
-            flat_dev = jnp.asarray(self._host_master)
-        new_params = self._offload_rebuild_jit(flat_dev)
+        # Chunk-pipelined host step (the stream overlap of ref
+        # stage2.py:743-941): all chunk D2H copies start async up
+        # front; while chunk i runs CPU-Adam, chunk i+1's download is
+        # in flight and chunk i-1's upload (async device_put inside
+        # jnp.asarray) is draining — D2H / compute / H2D overlap
+        # without threads.
+        bounds = self._offload_bounds(int(flat.size))
+        grad_chunks = [flat[lo:hi] for lo, hi in bounds]
+        for c in grad_chunks:
+            c.copy_to_host_async()
+        self._host_adam.begin_step()
+        out_chunks = []
+        for (lo, hi), c in zip(bounds, grad_chunks):
+            g_np = np.asarray(c, dtype=np.float32)
+            if self.compute_dtype == jnp.bfloat16:
+                # fused native chunk step + bf16 downcast in one pass
+                bf16_out = np.empty(hi - lo, np.uint16)
+                self._host_adam.step_chunk(
+                    lo, hi, self._host_master[lo:hi], g_np, lr=lr,
+                    params_bf16_out=bf16_out)
+                out_chunks.append(
+                    jnp.asarray(bf16_out).view(jnp.bfloat16))
+            else:
+                # fp16/fp32 compute: push fp32 masters, cast on device
+                # (a bf16 round-trip would truncate fp16's mantissa)
+                self._host_adam.step_chunk(
+                    lo, hi, self._host_master[lo:hi], g_np, lr=lr)
+                out_chunks.append(
+                    jnp.asarray(self._host_master[lo:hi].copy()))
+        new_params = self._offload_rebuild_jit(tuple(out_chunks))
         self.state = self.state._replace(
             params=new_params,
             scale=new_scale,

@@ -30,6 +30,10 @@ class CPUAdamBuilder(OpBuilder):
             ctypes.c_int, ctypes.c_int64, f32p, f32p, f32p, f32p, u16p,
             ctypes.c_float]
         lib.ds_adam_step_copy_bf16.restype = ctypes.c_int64
+        lib.ds_adam_step_chunk.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64, f32p, f32p,
+            f32p, f32p, u16p, ctypes.c_float]
+        lib.ds_adam_step_chunk.restype = ctypes.c_int64
         lib.ds_adam_get_step.argtypes = [ctypes.c_int]
         lib.ds_adam_get_step.restype = ctypes.c_int
         lib.ds_adam_set_step.argtypes = [ctypes.c_int, ctypes.c_int64]
