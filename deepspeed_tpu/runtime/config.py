@@ -376,6 +376,29 @@ class DeepSpeedConfig:
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bfloat16_enabled = get_bfloat16_enabled(param_dict)
+        # Apex AMP parity (ref config.py:66-77): meaningless on TPU —
+        # map "amp": {"enabled": true} to bf16 mixed precision, which
+        # is the hardware's native fast dtype
+        amp_dict = param_dict.get(C.AMP)
+        if amp_dict is not None and not isinstance(amp_dict, dict):
+            raise DeepSpeedConfigError(
+                f'"amp" must be a dict like {{"enabled": true}}, '
+                f"got {amp_dict!r}")
+        amp_dict = amp_dict or {}
+        self.amp_enabled = bool(amp_dict.get(C.AMP_ENABLED,
+                                             C.AMP_ENABLED_DEFAULT))
+        self.amp_params = {k: v for k, v in amp_dict.items()
+                           if k != C.AMP_ENABLED}
+        if self.amp_enabled:
+            # ref config asserts amp and fp16 are mutually exclusive
+            assert not self.fp16_enabled, \
+                "amp and fp16 modes cannot be simultaneously enabled"
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "amp.enabled maps to bf16 mixed precision on TPU "
+                "(Apex AMP does not exist here); amp params "
+                f"{list(self.amp_params)} are ignored")
+            self.bfloat16_enabled = True
         assert not (self.fp16_enabled and self.bfloat16_enabled), \
             "fp16 and bf16 modes are mutually exclusive"
         self.loss_scale = get_loss_scale(param_dict)

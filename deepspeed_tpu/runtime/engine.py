@@ -313,7 +313,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         return self._config.bfloat16_enabled
 
     def amp_enabled(self):
-        return False
+        # ref engine.py amp path; on TPU amp maps to bf16 (config.py)
+        return self._config.amp_enabled
+
+    def amp_params(self):
+        return self._config.amp_params
 
     def loss_scale(self):
         return float(jax.device_get(self.state.scale.loss_scale))

@@ -168,30 +168,71 @@ class PipelineEngine(DeepSpeedEngine):
         # the 1F1B program replaces the sequential-chain scan
         self._fused_step_jit = jax.jit(pipe_step, donate_argnums=(0,))
 
+    def _interp_example_mb(self, stacked_batch):
+        dp = self.mesh.shape[DATA_AXIS]
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                (np.asarray(x).shape[1] // dp,) + np.asarray(x).shape[2:],
+                np.asarray(x).dtype),
+            stacked_batch)
+
+    @staticmethod
+    def _batch_sig(stacked_batch):
+        return tuple(sorted(
+            (jax.tree_util.keystr(p), np.asarray(l).shape,
+             str(np.asarray(l).dtype))
+            for p, l in jax.tree_util.tree_flatten_with_path(
+                stacked_batch)[0]))
+
     def _ensure_interp(self, stacked_batch):
         """Lazy-build the compiled 1F1B step: boundary shapes come from
         the first batch (one LOCAL microbatch as seen inside shard_map:
         the per-microbatch batch dim divides over the data axis)."""
         if self._interp_fn is not None:
+            # the compiled program bakes the boundary avals of the
+            # first batch; silently padding a different shape would
+            # corrupt the flat activation transport
+            assert self._batch_sig(stacked_batch) == self._interp_sig, \
+                ("1F1B train batches must keep one shape; got "
+                 f"{self._batch_sig(stacked_batch)} after compiling for "
+                 f"{self._interp_sig}")
             return
+        self._interp_sig = self._batch_sig(stacked_batch)
         from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
-        dp = self.mesh.shape[DATA_AXIS]
-        example_mb = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                (np.asarray(x).shape[1] // dp,) + np.asarray(x).shape[2:],
-                np.asarray(x).dtype),
-            stacked_batch)
         self._interp_fn = build_pipeline_step(
             module=self.module, mesh=self.mesh,
             micro_batches=self.micro_batches,
             params_example=self.state.params,
-            batch_example=example_mb,
+            batch_example=self._interp_example_mb(stacked_batch),
             split_batch=_split_batch,
             det_accepting=_layers_accepting_deterministic(self.module))
         log_dist(
             f"PipelineEngine: compiled 1F1B schedule over "
             f"{self.num_stages} stages, {self.micro_batches} "
             "microbatches (clock-aligned TrainSchedule)", ranks=[0])
+
+    def _ensure_eval_interp(self, stacked_batch):
+        """Forward-only pipelined eval (the InferenceSchedule dataflow,
+        ref schedule.py:86-127): overlapped stage execution with the
+        2-buffer bound and no backward. Compiled per batch-shape (eval
+        batches commonly vary, e.g. a final partial batch)."""
+        sig = self._batch_sig(stacked_batch)
+        cache = getattr(self, "_eval_interp_cache", None)
+        if cache is None:
+            cache = self._eval_interp_cache = {}
+        if sig in cache:
+            self._eval_interp_jit = cache[sig]
+            return
+        from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
+        eval_fn = build_pipeline_step(
+            module=self.module, mesh=self.mesh,
+            micro_batches=self.micro_batches,
+            params_example=self.state.params,
+            batch_example=self._interp_example_mb(stacked_batch),
+            split_batch=_split_batch,
+            det_accepting=_layers_accepting_deterministic(self.module),
+            train=False)
+        self._eval_interp_jit = cache[sig] = jax.jit(eval_fn)
 
     # ------------------------------------------------------------------
     # batch API (ref pipe/engine.py:244,320)
@@ -231,6 +272,18 @@ class PipelineEngine(DeepSpeedEngine):
         # microbatches — same collection as train_batch
         if self._pipelined_protocol:
             batch = self._collect_full_batch(data_iter, batch)
+        elif getattr(self, "_use_1f1b", False):
+            m = self.micro_batches
+            batch = self._collect_full_batch(data_iter, batch)
+            stacked = jax.tree_util.tree_map(
+                lambda x: np.asarray(x).reshape(
+                    (m, np.asarray(x).shape[0] // m) +
+                    np.asarray(x).shape[1:]), _to_dict_batch(batch))
+            self._ensure_eval_interp(stacked)
+            return self._eval_interp_jit(
+                self.state.params,
+                jax.tree_util.tree_map(np.asarray, stacked),
+                jax.random.PRNGKey(0), np.float32(1.0))
         elif batch is None and data_iter is not None:
             batch = next(data_iter)
         batch = _to_dict_batch(batch)

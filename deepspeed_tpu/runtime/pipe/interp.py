@@ -49,8 +49,34 @@ from deepspeed_tpu.runtime.pipe.schedule import (
 # ----------------------------------------------------------------------
 # schedule -> clock tables
 # ----------------------------------------------------------------------
-def build_clock_tables(micro_batches, stages):
-    """Align the per-stage TrainSchedule streams on a global clock.
+def _inference_streams(m, S):
+    """Canonical fwd-only streams with InferenceSchedule's dataflow
+    (`schedule.py:86-127`). The literal InferenceSchedule emits
+    SendActivation one step AFTER the producing ForwardPass (a
+    host-runtime buffering detail); the compiled executor's send
+    register holds exactly one tick, so the send is folded into the
+    producing step — same dependency structure, same 2-buffer bound."""
+    streams = []
+    for s in range(S):
+        steps = []
+        for mb in range(m):
+            cmds = []
+            if s > 0:
+                cmds.append(RecvActivation(mb % 2))
+            if s == 0 or s == S - 1:
+                cmds.append(LoadMicroBatch(mb % 2))
+            cmds.append(ForwardPass(mb % 2))
+            if s < S - 1:
+                cmds.append(SendActivation(mb % 2))
+            steps.append(cmds)
+        streams.append(steps)
+    return streams
+
+
+def build_clock_tables(micro_batches, stages, train=True):
+    """Align the per-stage schedule streams on a global clock
+    (TrainSchedule, or the fwd-only InferenceSchedule dataflow when
+    train=False).
 
     Each stage executes at most one schedule step per tick; a step is
     eligible when every RecvActivation/RecvGrad it contains pairs with
@@ -58,7 +84,10 @@ def build_clock_tables(micro_batches, stages):
     with the k-th send — FIFO), and any Send* it contains has a free
     channel slot. Returns int/bool arrays indexed [tick, stage]."""
     m, S = micro_batches, stages
-    streams = [list(TrainSchedule(m, S, s).steps()) for s in range(S)]
+    if train:
+        streams = [list(TrainSchedule(m, S, s).steps()) for s in range(S)]
+    else:
+        streams = _inference_streams(m, S)
 
     fwd_mb = []
     fwd_buf = []
@@ -171,17 +200,20 @@ def _microbatch(tree, mb):
 
 
 def build_pipeline_step(module, mesh, micro_batches, params_example,
-                        batch_example, split_batch, det_accepting):
-    """Compile-time construction of the 1F1B step function
-    `(params, stacked_batch, rng, loss_scale) -> (loss, grads)`.
+                        batch_example, split_batch, det_accepting,
+                        train=True):
+    """Compile-time construction of the pipelined step function:
+    `(params, stacked_batch, rng, loss_scale) -> (loss, grads)` for
+    train=True (1F1B), or `... -> loss` for train=False (the fwd-only
+    InferenceSchedule dataflow — no saved buffers, no backward).
 
     params_example/batch_example: concrete or ShapeDtypeStruct pytrees
     used only for shape inference (batch_example is ONE microbatch).
     split_batch: callable batch -> (inputs, labels)."""
     S = mesh.shape[PIPE_AXIS]
     m = micro_batches
-    tables = build_clock_tables(m, S)
-    B = num_pipe_buffers(m, S)
+    tables = build_clock_tables(m, S, train=train)
+    B = num_pipe_buffers(m, S) if train else 1
     parts = module.parts
 
     inputs_ex, labels_ex = split_batch(batch_example)
@@ -238,7 +270,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         def fn(params, act_hold, batch, mb, rng, loss_scale):
             x = stage_input(s, act_hold, batch, mb)
             r = jax.random.fold_in(jax.random.fold_in(rng, mb), s)
-            y = run_stage(s, params, x, r, deterministic=False)
+            y = run_stage(s, params, x, r, deterministic=not train)
             if s == S - 1:
                 _, labels = split_batch(batch)
                 loss = module.loss_fn(y, _microbatch(labels, mb)) \
@@ -281,7 +313,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         return fn
 
     fwd_fns = [fwd_fn(s) for s in range(S)]
-    bwd_fns = [bwd_fn(s) for s in range(S)]
+    bwd_fns = [bwd_fn(s) for s in range(S)] if train else []
 
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
@@ -295,6 +327,39 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         # decorrelate dropout across data shards (stage folding happens
         # per-branch in fwd_fn/bwd_fn; fwd and recompute share the key)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+
+        if not train:
+            # minimal carry: no grads tree, no backward registers or
+            # saved-input buffers, no backward ppermute per tick
+            def tick_eval(carry, row):
+                act_hold, fwd_out, loss_sum = carry
+                perm_act = jax.lax.ppermute(fwd_out, PIPE_AXIS, fwd_perm)
+                act_hold = jnp.where(row["deliver_act"][s], perm_act,
+                                     act_hold)
+                my_fwd = row["fwd_mb"][s]
+
+                def do_fwd(_):
+                    return jax.lax.switch(
+                        s, fwd_fns, params, act_hold, stacked_batch,
+                        my_fwd, rng, loss_scale)
+
+                def no_fwd(_):
+                    return fwd_out, jnp.float32(0.0)
+
+                new_fwd_out, loss_inc = jax.lax.cond(
+                    my_fwd >= 0, do_fwd, no_fwd, None)
+                return (act_hold, new_fwd_out, loss_sum + loss_inc), None
+
+            carry, _ = jax.lax.scan(
+                tick_eval,
+                (jnp.zeros((A,), jnp.float32),
+                 jnp.zeros((A,), jnp.float32), jnp.float32(0.0)),
+                rows)
+            loss = jax.lax.psum(carry[2], PIPE_AXIS) / m
+            if dp > 1:
+                loss = jax.lax.pmean(loss, DATA_AXIS)
+            return loss
+
         zeros_grads = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
@@ -325,6 +390,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
             new_fwd_out, loss_inc = jax.lax.cond(my_fwd >= 0, do_fwd,
                                                  no_fwd, None)
+            loss_sum = loss_sum + loss_inc
             # save the stage-INPUT activation for backward recompute
             bufs = jnp.where(
                 my_fwd >= 0,
@@ -347,7 +413,6 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                                                  no_bwd, None)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc,
                                                dparams)
-            loss_sum = loss_sum + loss_inc
             return (act_hold, grad_hold, new_fwd_out, new_grad_out,
                     bufs, loss_sum, grads_acc), None
 
@@ -359,19 +424,16 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 jnp.float32(0.0), zeros_grads)
         carry, _ = jax.lax.scan(tick, init, rows)
         loss_sum = carry[5]
-        grads = carry[6]
-
+        loss = jax.lax.psum(loss_sum, PIPE_AXIS) / m
+        if dp > 1:
+            loss = jax.lax.pmean(loss, DATA_AXIS)
         # ReduceGrads + ReduceTiedGrads: stage-disjoint leaves psum to
         # their single producer's value; tied leaves SUM across stages
         grads = jax.tree_util.tree_map(
-            lambda g_: jax.lax.psum(g_, PIPE_AXIS), grads)
+            lambda g_: jax.lax.psum(g_, PIPE_AXIS), carry[6])
         if dp > 1:
             grads = jax.tree_util.tree_map(
                 lambda g_: jax.lax.pmean(g_, DATA_AXIS), grads)
-            loss = jax.lax.pmean(
-                jax.lax.psum(loss_sum, PIPE_AXIS) / m, DATA_AXIS)
-        else:
-            loss = jax.lax.psum(loss_sum, PIPE_AXIS) / m
         return loss, grads
 
     def step(params, stacked_batch, rng, loss_scale):
@@ -379,7 +441,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         return shard_map(
             local_step, mesh=mesh,
             in_specs=(P(), b_specs, P(), P()),
-            out_specs=(P(), P()),
+            out_specs=(P(), P()) if train else P(),
             check_vma=False)(params, stacked_batch, rng, loss_scale)
 
     return step

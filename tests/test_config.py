@@ -147,3 +147,19 @@ def test_checkpoint_tag_validation_modes():
         DeepSpeedConfig(
             base_config(checkpoint={"tag_validation": "bogus"}),
             world_size=1)
+
+
+def test_amp_maps_to_bf16():
+    """Apex AMP parity (ref config.py:66-77): amp.enabled engages bf16
+    mixed precision on TPU and exposes amp_params."""
+    import deepspeed_tpu
+    from simple_model import SimpleModel
+    m = SimpleModel()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=m.params,
+        config={"train_batch_size": 16,
+                "amp": {"enabled": True, "opt_level": "O1"},
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}})
+    assert engine.amp_enabled()
+    assert engine.bfloat16_enabled()
+    assert engine.amp_params() == {"opt_level": "O1"}

@@ -137,3 +137,21 @@ def test_checkpoint_elastic_across_padding(tmp_path):
         # training continues healthily after reload
         loss = e2.train_batch(batch=make_batch(9))
         assert np.isfinite(float(jax.device_get(loss)))
+
+
+def test_pad_plan_respects_tp_claimed_dims(mesh8):
+    """A dim already claimed by the model axis must not be chosen as
+    the padding dim (padding composes with tensor parallelism)."""
+    from jax.sharding import PartitionSpec as P
+    params = {"w": jnp.zeros((20, 24))}
+    specs = {"w": P("model", None)}          # dim0 is TP-claimed
+    policy = ZeroShardingPolicy(mesh8, stage=2, param_specs=specs)
+    plan = policy.pad_plan(params)
+    # dim1=24 % 8 == 0 -> divisible free dim exists, no padding at all
+    assert plan == {}
+    params = {"w": jnp.zeros((20, 20))}
+    specs = {"w": P("model", None)}
+    policy = ZeroShardingPolicy(mesh8, stage=2, param_specs=specs)
+    plan = policy.pad_plan(params)
+    (dim, padded, true), = plan.values()
+    assert dim == 1 and (padded, true) == (24, 20)
