@@ -1,0 +1,158 @@
+"""Parity tests for clusters the reference covers in separate files:
+argparse helpers (ref tests/unit/test_ds_arguments.py), multi-output
+models (test_multi_output_model.py), the dataloader (test_data.py),
+progressive layer drop (test_pld.py), and partition utilities
+(test_runtime_utils.py)."""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+from deepspeed_tpu.runtime.utils import (partition_balanced,
+                                         partition_uniform)
+from simple_model import SimpleModel
+
+
+# ----------------------------------------------------------------------
+# argparse helpers (ref test_ds_arguments.py)
+# ----------------------------------------------------------------------
+def test_add_config_arguments():
+    parser = argparse.ArgumentParser()
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args(["--deepspeed", "--deepspeed_config",
+                              "cfg.json"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "cfg.json"
+    args = parser.parse_args([])
+    assert args.deepspeed is False
+    assert args.deepspeed_config is None
+
+
+def test_add_config_arguments_preserves_existing():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--my_flag", type=int, default=3)
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    args = parser.parse_args(["--my_flag", "7", "--deepspeed"])
+    assert args.my_flag == 7 and args.deepspeed
+
+
+# ----------------------------------------------------------------------
+# multi-output model (ref test_multi_output_model.py)
+# ----------------------------------------------------------------------
+class TwoOutputModel:
+    """Engine-protocol model with two heads whose weighted losses sum —
+    the reference's MultiOutputModel shape."""
+
+    def __init__(self, dim=16, seed=0):
+        rng = np.random.RandomState(seed)
+        self.params = {
+            "w1": jnp.asarray(rng.randn(dim, dim) * 0.1, jnp.float32),
+            "w2": jnp.asarray(rng.randn(dim, dim) * 0.1, jnp.float32),
+        }
+        self.weights = (0.3, 0.7)
+
+    def loss_fn(self, params, batch, rngs=None, deterministic=False):
+        x = batch["x"].astype(jnp.float32)
+        y1 = batch["y1"].astype(jnp.float32)
+        y2 = batch["y2"].astype(jnp.float32)
+        l1 = jnp.mean((x @ params["w1"] - y1) ** 2)
+        l2 = jnp.mean((x @ params["w2"] - y2) ** 2)
+        return self.weights[0] * l1 + self.weights[1] * l2
+
+
+def test_multi_output_model_trains():
+    model = TwoOutputModel()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={"train_batch_size": 16, "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-2}}})
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 16).astype(np.float32)
+    w = np.linspace(-1, 1, 256).reshape(16, 16).astype(np.float32)
+    batch = {"x": x[None], "y1": (x @ w)[None], "y2": (x @ w.T)[None]}
+    losses = [float(jax.device_get(engine.train_batch(batch=batch)))
+              for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+# ----------------------------------------------------------------------
+# dataloader (ref test_data.py)
+# ----------------------------------------------------------------------
+def test_dataloader_batches_and_len():
+    data = [{"x": np.full((4,), i, np.float32)} for i in range(32)]
+    dl = DeepSpeedDataLoader(dataset=data, batch_size=8)
+    batches = list(dl)
+    assert len(dl) == 4 and len(batches) == 4
+    assert batches[0]["x"].shape == (8, 4)
+
+
+def test_dataloader_rank_slicing():
+    """Each dp rank must see a disjoint shard of the dataset."""
+    data = [{"x": np.full((2,), i, np.float32)} for i in range(16)]
+    seen = []
+    for rank in range(2):
+        dl = DeepSpeedDataLoader(dataset=data, batch_size=4,
+                                 data_parallel_world_size=2,
+                                 data_parallel_rank=rank)
+        for b in dl:
+            seen.extend(b["x"][:, 0].tolist())
+    assert sorted(set(seen)) == list(range(16))
+    assert len(seen) == 16  # disjoint, complete
+
+
+def test_repeating_loader():
+    data = [{"x": np.zeros((2,), np.float32)} for _ in range(4)]
+    dl = RepeatingLoader(DeepSpeedDataLoader(dataset=data, batch_size=2))
+    # draws past one epoch (2 batches) keep yielding
+    got = [next(dl) for _ in range(7)]
+    assert len(got) == 7
+
+
+# ----------------------------------------------------------------------
+# progressive layer drop (ref test_pld.py)
+# ----------------------------------------------------------------------
+def test_pld_theta_schedule_and_training():
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+    cfg = tiny_gpt2_config(n_layer=2, dropout=0.0)
+    model = GPT2ForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(0, 256,
+                                           (8, 32)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8, "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "progressive_layer_drop": {"enabled": True,
+                                           "theta": 0.5, "gamma": 0.01}})
+    assert engine.pld_enabled()
+    thetas = []
+    for _ in range(3):
+        loss = engine.train_batch(batch={"input_ids": ids[None]})
+        thetas.append(engine.pld_theta())
+    assert np.isfinite(float(jax.device_get(loss)))
+    # theta(t) = (1-theta)exp(-gamma t) + theta: decreasing toward theta
+    assert thetas[0] >= thetas[-1] >= 0.5
+
+
+# ----------------------------------------------------------------------
+# partition utilities (ref test_runtime_utils.py)
+# ----------------------------------------------------------------------
+def test_partition_uniform():
+    parts = partition_uniform(10, 3)
+    assert parts[0] == 0 and parts[-1] == 10 and len(parts) == 4
+    sizes = np.diff(parts)
+    assert sizes.max() - sizes.min() <= 1
+
+
+def test_partition_balanced():
+    weights = [1, 1, 1, 100, 1, 1]
+    parts = partition_balanced(weights, 2)
+    assert parts[0] == 0 and parts[-1] == len(weights)
+    # the heavy item must not share a part with everything else
+    loads = [sum(weights[parts[i]:parts[i + 1]]) for i in range(2)]
+    assert max(loads) <= 103
