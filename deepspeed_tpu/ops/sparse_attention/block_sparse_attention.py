@@ -5,18 +5,19 @@ softmax pipeline (`ops/sparse_attention/matmul.py:16-750`,
 `softmax.py:17-304`, `trsrc/*.tr`). The reference compiles per-layout
 lookup tables (`sdd_segment`, `csrc/sparse_attention/utils.cpp:117`)
 that enumerate the visible blocks; the TPU kernels do the same thing
-with scalar-prefetch index tables: for each query row-block the table
-lists exactly the visible key blocks (causality already folded in at
-block granularity), and the grid's inner dimension runs over THAT list
-— `kmax` steps instead of `nq`. Work therefore scales with layout
-density (a 16k-context window layout with ~6 visible blocks per row
-runs a 128x6 grid, not 128x128), while every step is still one dense
-128x128 MXU tile from a regular streaming access pattern.
+with scalar-prefetch index tables: for each q SUPER-ROW (qt adjacent
+layout rows — the kernel's q tile is qt*block rows) the table lists the
+union of visible key blocks, with a per-entry bitmask gating each
+member row; causality is folded in at block granularity. The grid's
+inner dimension runs over THAT list — `kmax` steps instead of `nq` —
+so work scales with layout density, while each step is one fat
+(g heads x qt*block x block) MXU tile from a regular streaming access
+pattern; head-grouping and super-rows exist to amortize per-grid-step
+overhead.
 
-The layout block size doubles as the kernel tile size (128 = one MXU
-tile; the reference's 16-wide Triton blocks would starve the MXU).
 Tables dedupe identical per-head layouts (the default for every shipped
-SparsityConfig) so the SMEM footprint is ~U*nq*kmax*4 bytes, a few KB.
+SparsityConfig); SMEM holds ~3*U*(nq/qt)*kmax int32 entries (indices,
+counts, masks) plus the transpose tables — a few KB.
 """
 
 import functools
@@ -34,39 +35,55 @@ from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF, _on_tpu,
 # ----------------------------------------------------------------------
 # layout -> visible-block index tables
 # ----------------------------------------------------------------------
-def _build_tables(layout, causal):
-    """Concrete [H, nq, nk] layout -> scalar-prefetch tables:
+def _build_tables(layout, causal, qt):
+    """Concrete [H, nq, nk] layout -> scalar-prefetch tables over
+    SUPER-ROWS of `qt` consecutive layout rows (the kernel's q tile is
+    qt*block rows — bigger MXU tiles, fewer grid steps):
 
-      head_map [H]          head -> unique-layout index u
-      kidx [U*nq*kmax]      visible key blocks per query row (padded)
-      kcnt [U*nq]           count of visible key blocks per query row
-      qidx [U*nq*qmax]      visible query blocks per key column (padded)
-      qcnt [U*nq]           count per key column
+      head_map [H]            head -> unique-layout index u
+      kidx [U*nqs*kmax]       visible key blocks per q super-row (union
+                              over member rows, padded)
+      kcnt [U*nqs]            count per q super-row
+      kmask [U*nqs*kmax]      per-entry bitmask: which of the qt member
+                              rows actually sees that key block
+      qidx/qcnt/qmask         the transpose (visible q super-rows per
+                              key column) for the dK/dV kernel
 
     Causality is folded in at block granularity (ki <= qi), so the
     kernels iterate ONLY over genuinely visible tiles — the TPU analog
     of the reference's sdd_segment lookup tables. Padding repeats index
-    0; padded steps are skipped by the count predicate."""
+    0 with an all-zero mask."""
     lay = np.asarray(layout, np.int32)
     unique, inverse = np.unique(lay, axis=0, return_inverse=True)
     U, nq, nk = unique.shape
+    assert nq % qt == 0
+    nqs = nq // qt
     vis = unique != 0
     if causal:
         vis = vis & np.tril(np.ones((nq, nk), bool))[None]
 
-    kcnt = vis.sum(axis=2).astype(np.int32)               # [U, nq]
-    qcnt = vis.sum(axis=1).astype(np.int32)               # [U, nk]
+    vis_s = vis.reshape(U, nqs, qt, nk)
+    union = vis_s.any(axis=2)                              # [U, nqs, nk]
+    bits = (vis_s.astype(np.int32) <<
+            np.arange(qt)[None, None, :, None]).sum(axis=2)  # [U,nqs,nk]
+
+    kcnt = union.sum(axis=2).astype(np.int32)              # [U, nqs]
+    qcnt = union.sum(axis=1).astype(np.int32)              # [U, nk]
     kmax = max(1, int(kcnt.max()))
     qmax = max(1, int(qcnt.max()))
-    kidx = np.zeros((U, nq, kmax), np.int32)
+    kidx = np.zeros((U, nqs, kmax), np.int32)
+    kmask = np.zeros((U, nqs, kmax), np.int32)
     qidx = np.zeros((U, nk, qmax), np.int32)
+    qmask = np.zeros((U, nk, qmax), np.int32)
     for u in range(U):
-        for qi in range(nq):
-            cols = np.where(vis[u, qi])[0]
-            kidx[u, qi, :len(cols)] = cols
+        for R in range(nqs):
+            cols = np.where(union[u, R])[0]
+            kidx[u, R, :len(cols)] = cols
+            kmask[u, R, :len(cols)] = bits[u, R, cols]
         for ki in range(nk):
-            rows = np.where(vis[u, :, ki])[0]
+            rows = np.where(union[u, :, ki])[0]
             qidx[u, ki, :len(rows)] = rows
+            qmask[u, ki, :len(rows)] = bits[u, rows, ki]
     # head-group size: the largest power of two (<=8) dividing H whose
     # groups are layout-uniform — grouped heads ride one grid step
     hm = inverse.reshape(-1)
@@ -80,7 +97,9 @@ def _build_tables(layout, causal):
             break
     return (jnp.asarray(hm, jnp.int32),
             jnp.asarray(kidx.reshape(-1)), jnp.asarray(kcnt.reshape(-1)),
+            jnp.asarray(kmask.reshape(-1)),
             jnp.asarray(qidx.reshape(-1)), jnp.asarray(qcnt.reshape(-1)),
+            jnp.asarray(qmask.reshape(-1)),
             kmax, qmax, g)
 
 
@@ -92,15 +111,32 @@ def _row(hm_ref, bhi, qi, nq, num_heads):
 # ----------------------------------------------------------------------
 # kernels (grid inner dim = visible-block list position)
 # ----------------------------------------------------------------------
-def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
-                   o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
-                   causal, block, num_heads, nq, kmax, g):
-    # blocks carry G heads per grid step (legal because grouped heads
-    # share one layout row): fewer, fatter steps amortize the per-step
-    # grid/DMA overhead that starves 128-row single-head tiles
-    qi = pl.program_id(1)
+def _visible_mask(mbits, R, ki, qt, block, causal):
+    """[qt*block, block] bool: which score entries are visible — the
+    per-member-row layout bit, intersected with the causal triangle in
+    GLOBAL coordinates when causal."""
+    qtb = qt * block
+    rows = jax.lax.broadcasted_iota(jnp.int32, (qtb, block), 0)
+    visible = ((mbits >> (rows // block)) & 1) == 1
+    if causal:
+        grows = R * qtb + rows
+        cols = ki * block + jax.lax.broadcasted_iota(
+            jnp.int32, (qtb, block), 1)
+        visible = visible & (grows >= cols)
+    return visible
+
+
+def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref, k_ref,
+                   v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
+                   sm_scale, causal, block, num_heads, nqs, kmax, g, qt):
+    # blocks carry G heads x QT layout rows per grid step (legal because
+    # grouped heads share one layout row): fewer, fatter steps amortize
+    # the per-step grid/DMA overhead that starves small tiles; the
+    # bitmask gates each member row on its own layout visibility
+    R = pl.program_id(1)
     st = pl.program_id(2)
-    row = _row(hm_ref, pl.program_id(0) * g, qi, nq, num_heads)
+    row = _row(hm_ref, pl.program_id(0) * g, R, nqs, num_heads)
+    qtb = qt * block
 
     @pl.when(st == 0)
     def _():
@@ -111,23 +147,23 @@ def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
     @pl.when(st < kcnt_ref[row])
     def _():
         ki = kidx_ref[row * kmax + st]
+        mbits = kmask_ref[row * kmax + st]
         q = q_ref[...]
         k = k_ref[...]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale   # [G, B, B]
-        if causal:
-            rows = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            cols = ki * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
+            preferred_element_type=jnp.float32) * sm_scale  # [G, QTB, B]
+        s = jnp.where(
+            _visible_mask(mbits, R, ki, qt, block, causal)[None],
+            s, NEG_INF)
 
         m_prev = m_scr[:, :, :1]
         l_prev = l_scr[:, :, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
+        # rows with no visible block this step keep m=-inf; guard exp
+        m_safe = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.minimum(m_prev - m_safe, 0.0))
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         v = v_ref[...]
         pv = jax.lax.dot_general(
@@ -144,13 +180,16 @@ def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
         lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
 
 
-def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, q_ref, k_ref, v_ref,
-                       do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                       dk_scr, dv_scr, *, sm_scale, causal, block,
-                       num_heads, nq, qmax, g):
+def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, qmask_ref, q_ref,
+                       k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                       dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
+                       block, num_heads, nqs, qmax, g, qt):
     ki = pl.program_id(1)
     st = pl.program_id(2)
-    row = _row(hm_ref, pl.program_id(0) * g, ki, nq, num_heads)
+    # the q-side tables for dK/dV are indexed by KEY column: nk == nq
+    # rows in the flat [U, nk] layout (square layouts asserted)
+    row = _row(hm_ref, pl.program_id(0) * g, ki, nqs * qt, num_heads)
+    qtb = qt * block
 
     @pl.when(st == 0)
     def _():
@@ -159,7 +198,8 @@ def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, q_ref, k_ref, v_ref,
 
     @pl.when(st < qcnt_ref[row])
     def _():
-        qi = qidx_ref[row * qmax + st]
+        R = qidx_ref[row * qmax + st]
+        mbits = qmask_ref[row * qmax + st]
         q = q_ref[...]
         k = k_ref[...]
         v = v_ref[...]
@@ -168,13 +208,10 @@ def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, q_ref, k_ref, v_ref,
         delta = delta_ref[...]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale   # [G, Bq, Bk]
-        if causal:
-            rows = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            cols = ki * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
+            preferred_element_type=jnp.float32) * sm_scale  # [G,QTB,B]
+        s = jnp.where(
+            _visible_mask(mbits, R, ki, qt, block, causal)[None],
+            s, NEG_INF)
         p = jnp.exp(s - lse)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
@@ -193,12 +230,14 @@ def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, q_ref, k_ref, v_ref,
         dv_ref[...] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
-                      do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
-                      sm_scale, causal, block, num_heads, nq, kmax, g):
-    qi = pl.program_id(1)
+def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref,
+                      k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                      dq_scr, *, sm_scale, causal, block, num_heads,
+                      nqs, kmax, g, qt):
+    R = pl.program_id(1)
     st = pl.program_id(2)
-    row = _row(hm_ref, pl.program_id(0) * g, qi, nq, num_heads)
+    row = _row(hm_ref, pl.program_id(0) * g, R, nqs, num_heads)
+    qtb = qt * block
 
     @pl.when(st == 0)
     def _():
@@ -207,6 +246,7 @@ def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
     @pl.when(st < kcnt_ref[row])
     def _():
         ki = kidx_ref[row * kmax + st]
+        mbits = kmask_ref[row * kmax + st]
         q = q_ref[...]
         k = k_ref[...]
         v = v_ref[...]
@@ -216,12 +256,9 @@ def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = qi * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 0)
-            cols = ki * block + jax.lax.broadcasted_iota(
-                jnp.int32, (block, block), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
+        s = jnp.where(
+            _visible_mask(mbits, R, ki, qt, block, causal)[None],
+            s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((2,), (2,)), ((0,), (0,))),
@@ -239,51 +276,52 @@ def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, q_ref, k_ref, v_ref,
 # ----------------------------------------------------------------------
 # pallas_call plumbing
 # ----------------------------------------------------------------------
-def _k_lookup(nq, kmax, num_heads, g):
+def _k_lookup(nqs, kmax, num_heads, g):
     """BlockSpec index fn for k/v: the key block comes from the table."""
-    def idx(grp, qi, st, hm_ref, kidx_ref, kcnt_ref):
-        row = _row(hm_ref, grp * g, qi, nq, num_heads)
+    def idx(grp, R, st, hm_ref, kidx_ref, kcnt_ref, kmask_ref):
+        row = _row(hm_ref, grp * g, R, nqs, num_heads)
         return (grp, kidx_ref[row * kmax + st], 0)
     return idx
 
 
-def _q_lookup(nq, qmax, num_heads, g):
-    def idx(grp, ki, st, hm_ref, qidx_ref, qcnt_ref):
-        row = _row(hm_ref, grp * g, ki, nq, num_heads)
+def _q_lookup(nk, qmax, num_heads, g):
+    def idx(grp, ki, st, hm_ref, qidx_ref, qcnt_ref, qmask_ref):
+        row = _row(hm_ref, grp * g, ki, nk, num_heads)
         return (grp, qidx_ref[row * qmax + st], 0)
     return idx
 
 
-def _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal, block,
-            interpret, kmax, g):
+def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
+            block, interpret, kmax, g, qt):
     b, t, h, d = q.shape
     bh = b * h
-    nq = t // block
+    nqs = t // block // qt
+    qtb = qt * block
 
     def to_bht(x):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
 
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block=block, num_heads=h,
-                               nq=nq, kmax=kmax, g=g)
-    fixed = lambda grp, qi, st, *_: (grp, qi, 0)
-    kv = _k_lookup(nq, kmax, h, g)
+                               nqs=nqs, kmax=kmax, g=g, qt=qt)
+    fixed = lambda grp, R, st, *_: (grp, R, 0)
+    kv = _k_lookup(nqs, kmax, h, g)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(bh // g, nq, kmax),
+        num_scalar_prefetch=4,
+        grid=(bh // g, nqs, kmax),
         in_specs=[
-            pl.BlockSpec((g, block, d), fixed),
+            pl.BlockSpec((g, qtb, d), fixed),
             pl.BlockSpec((g, block, d), kv),
             pl.BlockSpec((g, block, d), kv),
         ],
         out_specs=[
-            pl.BlockSpec((g, block, d), fixed),
-            pl.BlockSpec((g, block, 1), fixed),
+            pl.BlockSpec((g, qtb, d), fixed),
+            pl.BlockSpec((g, qtb, 1), fixed),
         ],
         scratch_shapes=[
-            pltpu.VMEM((g, block, 128), jnp.float32),
-            pltpu.VMEM((g, block, 128), jnp.float32),
-            pltpu.VMEM((g, block, d), jnp.float32),
+            pltpu.VMEM((g, qtb, 128), jnp.float32),
+            pltpu.VMEM((g, qtb, 128), jnp.float32),
+            pltpu.VMEM((g, qtb, d), jnp.float32),
         ],
     )
     out, lse = pl.pallas_call(
@@ -294,16 +332,19 @@ def _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal, block,
             jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(head_map, kidx, kcnt, to_bht(q), to_bht(k), to_bht(v))
+    )(head_map, kidx, kcnt, kmask, to_bht(q), to_bht(k), to_bht(v))
     return out, lse
 
 
-def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, res,
-            g):
-    q, k, v, out, lse, head_map, kidx, kcnt, qidx, qcnt = res
+def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
+            res, g):
+    (q, k, v, out, lse, head_map, kidx, kcnt, kmask, qidx, qcnt,
+     qmask) = res
     b, t, h, d = q.shape
     bh = b * h
-    nq = t // block
+    nk = t // block
+    nqs = nk // qt
+    qtb = qt * block
 
     def to_bht(x):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
@@ -311,27 +352,27 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, res,
     def from_bht(x):
         return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
-    qt, kt, vt, dot_ = to_bht(q), to_bht(k), to_bht(v), to_bht(g)
+    qt_, kt, vt, dot_ = to_bht(q), to_bht(k), to_bht(v), to_bht(g)
     ot = to_bht(out)
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1, keepdims=True)
 
     fixed1 = lambda grp, ki, st, *_: (grp, ki, 0)
-    qv = _q_lookup(nq, qmax, h, g_grp)
+    qv = _q_lookup(nk, qmax, h, g_grp)
     dkv_kernel = functools.partial(_bs_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block=block,
-                                   num_heads=h, nq=nq, qmax=qmax,
-                                   g=g_grp)
+                                   num_heads=h, nqs=nqs, qmax=qmax,
+                                   g=g_grp, qt=qt)
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(bh // g_grp, nq, qmax),
+        num_scalar_prefetch=4,
+        grid=(bh // g_grp, nk, qmax),
         in_specs=[
-            pl.BlockSpec((g_grp, block, d), qv),      # q from table
+            pl.BlockSpec((g_grp, qtb, d), qv),      # q super-row
             pl.BlockSpec((g_grp, block, d), fixed1),  # k at ki
             pl.BlockSpec((g_grp, block, d), fixed1),  # v at ki
-            pl.BlockSpec((g_grp, block, d), qv),      # do from table
-            pl.BlockSpec((g_grp, block, 1), qv),      # lse from table
-            pl.BlockSpec((g_grp, block, 1), qv),      # delta from table
+            pl.BlockSpec((g_grp, qtb, d), qv),      # do super-row
+            pl.BlockSpec((g_grp, qtb, 1), qv),      # lse super-row
+            pl.BlockSpec((g_grp, qtb, 1), qv),      # delta super-row
         ],
         out_specs=[
             pl.BlockSpec((g_grp, block, d), fixed1),
@@ -350,63 +391,64 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, res,
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
         ],
         interpret=interpret,
-    )(head_map, qidx, qcnt, qt, kt, vt, dot_, lse, delta)
+    )(head_map, qidx, qcnt, qmask, qt_, kt, vt, dot_, lse, delta)
 
-    fixed = lambda grp, qi, st, *_: (grp, qi, 0)
-    kv = _k_lookup(nq, kmax, h, g_grp)
+    fixed = lambda grp, R, st, *_: (grp, R, 0)
+    kv = _k_lookup(nqs, kmax, h, g_grp)
     dq_kernel = functools.partial(_bs_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block=block,
-                                  num_heads=h, nq=nq, kmax=kmax,
-                                  g=g_grp)
+                                  num_heads=h, nqs=nqs, kmax=kmax,
+                                  g=g_grp, qt=qt)
     dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(bh // g_grp, nq, kmax),
+        num_scalar_prefetch=4,
+        grid=(bh // g_grp, nqs, kmax),
         in_specs=[
-            pl.BlockSpec((g_grp, block, d), fixed),
+            pl.BlockSpec((g_grp, qtb, d), fixed),
             pl.BlockSpec((g_grp, block, d), kv),
             pl.BlockSpec((g_grp, block, d), kv),
-            pl.BlockSpec((g_grp, block, d), fixed),
-            pl.BlockSpec((g_grp, block, 1), fixed),
-            pl.BlockSpec((g_grp, block, 1), fixed),
+            pl.BlockSpec((g_grp, qtb, d), fixed),
+            pl.BlockSpec((g_grp, qtb, 1), fixed),
+            pl.BlockSpec((g_grp, qtb, 1), fixed),
         ],
-        out_specs=pl.BlockSpec((g_grp, block, d), fixed),
-        scratch_shapes=[pltpu.VMEM((g_grp, block, d), jnp.float32)],
+        out_specs=pl.BlockSpec((g_grp, qtb, d), fixed),
+        scratch_shapes=[pltpu.VMEM((g_grp, qtb, d), jnp.float32)],
     )
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(head_map, kidx, kcnt, qt, kt, vt, dot_, lse, delta)
+    )(head_map, kidx, kcnt, kmask, qt_, kt, vt, dot_, lse, delta)
 
     return (from_bht(dq), from_bht(dk), from_bht(dv),
-            None, None, None, None, None)
+            None, None, None, None, None, None, None)
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(8, 9, 10, 11, 12, 13, 14))
-def _bs_flash(q, k, v, head_map, kidx, kcnt, qidx, qcnt, sm_scale,
-              causal, block, interpret, kmax, qmax, g):
-    out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal,
-                     block, interpret, kmax, g)
+                   nondiff_argnums=(10, 11, 12, 13, 14, 15, 16, 17))
+def _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt, qmask,
+              sm_scale, causal, block, interpret, kmax, qmax, g, qt):
+    out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
+                     causal, block, interpret, kmax, g, qt)
     b, t, h, d = q.shape
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, qidx, qcnt, sm_scale,
-                  causal, block, interpret, kmax, qmax, g):
-    out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, sm_scale, causal,
-                       block, interpret, kmax, g)
+def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
+                  qmask, sm_scale, causal, block, interpret, kmax, qmax,
+                  g, qt):
+    out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
+                       causal, block, interpret, kmax, g, qt)
     b, t, h, d = q.shape
     out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return out_bthd, (q, k, v, out_bthd, lse, head_map, kidx, kcnt,
-                      qidx, qcnt)
+                      kmask, qidx, qcnt, qmask)
 
 
 def _bs_flash_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp,
-                  res, g):
+                  qt, res, g):
     return _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax,
-                   g_grp, res, g)
+                   g_grp, qt, res, g)
 
 
 _bs_flash.defvjp(_bs_flash_fwd, _bs_flash_bwd)
@@ -448,16 +490,22 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         sm_scale = 1.0 / np.sqrt(d)
     if interpret is None:
         interpret = not _on_tpu()
-    head_map, kidx, kcnt, qidx, qcnt, kmax, qmax, g = _build_tables(
-        layout, causal)
+    # q super-tile: target ~512 query rows per grid step; must divide
+    # the block-row count. Head-group g then fits the VMEM tile budget.
+    nq = t // block
+    qt = max(1, min(4, 512 // block, nq))
+    while nq % qt != 0:
+        qt -= 1
+    (head_map, kidx, kcnt, kmask, qidx, qcnt, qmask, kmax, qmax,
+     g) = _build_tables(layout, causal, qt)
     assert h % g == 0 and (b * h) % g == 0  # _build_tables guarantees
-    # VMEM tile budget: the f32 score tile is g*block*block*4 bytes;
-    # keep g*block <= 2048 (16 MB VMEM, double-buffered operands)
-    while g > 1 and g * block > 2048:
+    # VMEM tile budget: the f32 score tile is g*qt*block*block*4 bytes;
+    # keep g*qt*block <= 2048 (16 MB VMEM, double-buffered operands)
+    while g > 1 and g * qt * block > 2048:
         g //= 2
-    return _bs_flash(q, k, v, head_map, kidx, kcnt, qidx, qcnt,
-                     float(sm_scale), bool(causal), int(block),
-                     bool(interpret), kmax, qmax, g)
+    return _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
+                     qmask, float(sm_scale), bool(causal), int(block),
+                     bool(interpret), kmax, qmax, g, qt)
 
 
 def block_sparse_attention_dense_fallback(q, k, v, layout, block,

@@ -74,17 +74,19 @@ def test_kernel_matches_dense_fallback(cfg, causal):
                                atol=2e-5, rtol=2e-5)
 
 
-def test_kernel_grads_match_dense_fallback():
+@pytest.mark.parametrize("causal", [False, True])
+def test_kernel_grads_match_dense_fallback(causal):
     q, k, v = qkv(seed=7)
     layout = FixedSparsityConfig(
         num_heads=H, block=BLOCK, num_local_blocks=2).make_layout(T)
 
     def loss_sparse(q, k, v):
-        return jnp.sum(block_sparse_attention(q, k, v, layout, BLOCK) ** 2)
+        return jnp.sum(block_sparse_attention(
+            q, k, v, layout, BLOCK, causal=causal) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(block_sparse_attention_dense_fallback(
-            q, k, v, layout, BLOCK) ** 2)
+            q, k, v, layout, BLOCK, causal=causal) ** 2)
 
     gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
