@@ -47,6 +47,17 @@ class GPT2Config:
     # for full-block remat.
     remat_policy: Optional[str] = None
     attention_impl: str = "auto"    # auto | pallas | xla
+    # Sequence/context parallelism for long sequences: shard T over a
+    # mesh axis and run ring (ppermute KV rotation) or ulysses
+    # (all-to-all head swap) attention. Set sp_mesh to the engine mesh
+    # and sp_axis to the axis carrying the sequence. By convention this
+    # is the model axis, which the engine ALSO uses for Megatron-style
+    # tensor parallelism (tp_param_specs): params stay TP-sharded while
+    # activations enter attention seq-sharded — the usual TP+SP
+    # composition, at the cost of a reshard on entry/exit per layer.
+    sequence_parallel: Optional[str] = None   # None | "ring" | "ulysses"
+    sp_mesh: Any = None
+    sp_axis: str = "model"
     initializer_range: float = 0.02
 
     @property
@@ -94,6 +105,23 @@ def causal_attention_xla(q, k, v, dropout_rng=None, dropout_rate=0.0,
 
 
 def _attention(config, q, k, v, dropout_rng, deterministic):
+    if config.sequence_parallel:
+        # shard_map over the sequence axis composes inside the engine's
+        # GSPMD step: activations reshard to [B, T/sp, H, D] on entry
+        from deepspeed_tpu.ops.sequence import (ring_attention,
+                                                ulysses_attention)
+        assert config.sp_mesh is not None, \
+            "sequence_parallel requires sp_mesh (pass the engine mesh)"
+        assert deterministic or config.dropout == 0.0, \
+            "attention dropout is not supported under sequence parallelism"
+        impls = {"ring": ring_attention, "ulysses": ulysses_attention}
+        if config.sequence_parallel not in impls:
+            raise ValueError(
+                f"sequence_parallel={config.sequence_parallel!r}; "
+                f"valid values: {sorted(impls)} or None")
+        fn = impls[config.sequence_parallel]
+        return fn(q, k, v, mesh=config.sp_mesh,
+                  axis_name=config.sp_axis, causal=True)
     if config.attention_impl in ("pallas", "auto"):
         try:
             from deepspeed_tpu.ops.transformer.flash_attention import (
