@@ -108,3 +108,22 @@ from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerLayer,
 # checkpointing module at package level).
 from deepspeed_tpu.runtime.activation_checkpointing import \
     checkpointing  # noqa: F401
+
+# Backwards compatibility with the old `deepspeed.pt` module structure
+# (ref `__init__.py:37-47`): alias runtime modules under a dummy `pt`
+# submodule so `import deepspeed_tpu.pt.deepspeed_utils` etc. resolve.
+import sys as _sys
+import types as _types
+
+from deepspeed_tpu.runtime import config as _config_mod
+from deepspeed_tpu.runtime import utils as _utils_mod
+from deepspeed_tpu.runtime.fp16 import loss_scaler as _loss_scaler_mod
+
+pt = _types.ModuleType("pt", "dummy pt module for backwards compatability")
+pt.deepspeed_utils = _utils_mod
+pt.deepspeed_config = _config_mod
+pt.loss_scaler = _loss_scaler_mod
+_sys.modules[__name__ + ".pt"] = pt
+_sys.modules[__name__ + ".pt.deepspeed_utils"] = _utils_mod
+_sys.modules[__name__ + ".pt.deepspeed_config"] = _config_mod
+_sys.modules[__name__ + ".pt.loss_scaler"] = _loss_scaler_mod

@@ -1,0 +1,7 @@
+"""Alias of the module-injection API under `ops` — the reference ships
+the older copy-based injection twice (`deepspeed/ops/module_inject.py`
+duplicating `deepspeed/module_inject/`); here the ops-path module simply
+re-exports the single implementation."""
+
+from deepspeed_tpu.module_inject.replace_module import (  # noqa: F401
+    replace_transformer_layer, revert_transformer_layer, replace_module)
