@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Minimal GPT-2 pretraining with deepspeed_tpu — the Megatron-GPT2
+example shape from DeepSpeedExamples, TPU-native.
+
+Run (single host):
+    python examples/gpt2_train.py --deepspeed \
+        --deepspeed_config examples/ds_config_gpt2.json
+
+Multi-host (pod): launch with `bin/dstpu --hostfile ... examples/gpt2_train.py ...`
+and the engine picks up jax.distributed from the launcher env.
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import numpy as np
+
+# runnable from a source checkout without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
+
+
+def get_args():
+    parser = argparse.ArgumentParser(description="GPT-2 pretraining")
+    parser.add_argument("--model", default="gpt2-125m",
+                        help="gpt2-125m .. gpt2-13b")
+    parser.add_argument("--seq-len", type=int, default=1024)
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--seed", type=int, default=42)
+    parser = deepspeed_tpu.add_config_arguments(parser)
+    return parser.parse_args()
+
+
+def synthetic_batches(vocab, micro_bs, gas, seq, seed):
+    rng = np.random.default_rng(seed)
+    while True:
+        yield {"input_ids": rng.integers(
+            0, vocab, (gas, micro_bs, seq)).astype(np.int32)}
+
+
+def main():
+    args = get_args()
+    cfg = gpt2_config(args.model, n_positions=args.seq_len, dropout=0.0,
+                      remat=True,
+                      remat_policy="dots_with_no_batch_dims_saveable")
+    model = GPT2ForCausalLM(cfg)
+    example = {"input_ids": np.zeros((1, args.seq_len), np.int32)}
+    params = model.init(jax.random.PRNGKey(args.seed), example)
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        args=args, model=model, model_parameters=params)
+
+    micro = engine.train_micro_batch_size_per_gpu()
+    gas = engine.gradient_accumulation_steps()
+    data = synthetic_batches(cfg.vocab_size, micro, gas, args.seq_len,
+                             args.seed)
+    for step in range(args.steps):
+        loss = engine.train_batch(batch=next(data))
+        if step % engine.steps_per_print() == 0:
+            deepspeed_tpu.log_dist(
+                f"step {step}: loss {float(jax.device_get(loss)):.4f}",
+                ranks=[0])
+    engine.save_checkpoint("checkpoints/gpt2")
+
+
+if __name__ == "__main__":
+    main()
