@@ -243,11 +243,20 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(a))
         for a in bnd[:-1]]
     A = max(flat_sizes) if flat_sizes else 1
+    # transport dtype for the flat activation/cotangent buffers: the
+    # boundaries' common float dtype (bf16 models move half the pipe
+    # bytes); any non-float leaf (e.g. ids threaded through) forces f32
+    bleaves = [l for a in bnd[:-1] for l in jax.tree_util.tree_leaves(a)]
+    if bleaves and all(jnp.issubdtype(l.dtype, jnp.floating)
+                       for l in bleaves):
+        tdt = jnp.result_type(*[l.dtype for l in bleaves])
+    else:
+        tdt = jnp.float32
 
     def to_flat(tree):
-        leaves = [l.reshape(-1).astype(jnp.float32)
+        leaves = [l.reshape(-1).astype(tdt)
                   for l in jax.tree_util.tree_leaves(tree)]
-        flat = jnp.concatenate(leaves) if leaves else jnp.zeros((0,))
+        flat = jnp.concatenate(leaves) if leaves else jnp.zeros((0,), tdt)
         return jnp.pad(flat, (0, A - flat.shape[0]))
 
     def from_flat(flat, aval):
@@ -275,7 +284,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 _, labels = split_batch(batch)
                 loss = module.loss_fn(y, _microbatch(labels, mb)) \
                     if module.loss_fn is not None else y
-                return jnp.zeros((A,), jnp.float32), \
+                return jnp.zeros((A,), tdt), \
                     loss.astype(jnp.float32)
             return to_flat(y), jnp.float32(0.0)
         return fn
@@ -302,7 +311,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             if s == 0:
                 _, vjp = jax.vjp(lambda p: g(p, x), params)
                 (dparams,) = vjp(cot)
-                dx_flat = jnp.zeros((A,), jnp.float32)
+                dx_flat = jnp.zeros((A,), tdt)
             else:
                 _, vjp = jax.vjp(g, params, x)
                 dparams, dx = vjp(cot)
@@ -352,8 +361,8 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
             carry, _ = jax.lax.scan(
                 tick_eval,
-                (jnp.zeros((A,), jnp.float32),
-                 jnp.zeros((A,), jnp.float32), jnp.float32(0.0)),
+                (jnp.zeros((A,), tdt),
+                 jnp.zeros((A,), tdt), jnp.float32(0.0)),
                 rows)
             loss = jax.lax.psum(carry[2], PIPE_AXIS) / m
             if dp > 1:
@@ -416,11 +425,11 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             return (act_hold, grad_hold, new_fwd_out, new_grad_out,
                     bufs, loss_sum, grads_acc), None
 
-        init = (jnp.zeros((A,), jnp.float32),   # act_hold
-                jnp.zeros((A,), jnp.float32),   # grad_hold
-                jnp.zeros((A,), jnp.float32),   # fwd_out
-                jnp.zeros((A,), jnp.float32),   # grad_out
-                jnp.zeros((B, A), jnp.float32),  # saved stage inputs
+        init = (jnp.zeros((A,), tdt),    # act_hold
+                jnp.zeros((A,), tdt),    # grad_hold
+                jnp.zeros((A,), tdt),    # fwd_out
+                jnp.zeros((A,), tdt),    # grad_out
+                jnp.zeros((B, A), tdt),  # saved stage inputs
                 jnp.float32(0.0), zeros_grads)
         carry, _ = jax.lax.scan(tick, init, rows)
         loss_sum = carry[5]

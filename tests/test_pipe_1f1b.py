@@ -26,21 +26,22 @@ def mse_loss(pred, labels):
                      labels.astype(jnp.float32)) ** 2)
 
 
-def hetero_module(num_stages):
+def hetero_module(num_stages, layer_dtype=None):
     """Deliberately heterogeneous: different widths per stage and a
     plain-callable (paramless) layer in the chain."""
     layers = [
-        LayerSpec(nn.Dense, DMID),
+        LayerSpec(nn.Dense, DMID, dtype=layer_dtype),
         jnp.tanh,                       # paramless callable layer
-        LayerSpec(nn.Dense, DMID * 2),
-        LayerSpec(nn.Dense, DOUT),
+        LayerSpec(nn.Dense, DMID * 2, dtype=layer_dtype),
+        LayerSpec(nn.Dense, DOUT, dtype=layer_dtype),
     ]
     return PipelineModule(layers, num_stages=num_stages, loss_fn=mse_loss,
                           partition_method="uniform")
 
 
-def make_engine(num_stages, pipe, data, gas, seed=0):
-    module = hetero_module(num_stages)
+def make_engine(num_stages, pipe, data, gas, seed=0, layer_dtype=None,
+                **cfg_over):
+    module = hetero_module(num_stages, layer_dtype=layer_dtype)
     rng = np.random.RandomState(seed)
     example = jnp.asarray(rng.randn(4, DIN), jnp.float32)
     params = module.init_params(jax.random.PRNGKey(seed), example)
@@ -51,6 +52,7 @@ def make_engine(num_stages, pipe, data, gas, seed=0):
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "mesh": {"pipe": pipe, "data": data, "model": 1},
     }
+    cfg.update(cfg_over)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=module, model_parameters=params, config=cfg)
     return engine
@@ -262,3 +264,27 @@ def test_1f1b_with_zero2_padding():
         engine.train_batch(batch={"x": x, "y": y}))) for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_bf16_transport_matches_sequential():
+    """bf16-activation models move bf16 activation/cotangent buffers
+    through the pipe (half the wire bytes) and still match the
+    sequential chain. Layers compute in bf16 so the stage boundaries
+    really ARE bf16 (default-dtype Dense would promote back to f32)."""
+    def run(pipe, data):
+        engine = make_engine(num_stages=pipe, pipe=pipe, data=data,
+                             gas=4, layer_dtype=jnp.bfloat16,
+                             **{"bf16": {"enabled": True}})
+        return engine, [float(jax.device_get(
+            engine.train_batch(batch=full_batch(4, seed=i))))
+            for i in range(4)]
+
+    _, losses_seq = run(1, 8)
+    pp, losses_pp = run(2, 4)
+    np.testing.assert_allclose(losses_pp, losses_seq, rtol=5e-3)
+    # the stage boundary (and hence the transport buffer dtype chosen
+    # by build_pipeline_step) must actually be bf16
+    out = pp.module.apply_layer(
+        0, pp.module.layer_params(jax.device_get(pp.state.params), 0),
+        jnp.zeros((2, DIN), jnp.float32))
+    assert out.dtype == jnp.bfloat16, out.dtype
