@@ -1,16 +1,34 @@
 #!/usr/bin/env python
 """Benchmarks on real TPU hardware across the BASELINE.json config list.
 
-Prints ONE JSON line whose headline is GPT-2 training throughput
-(tokens/s/chip, `vs_baseline` = achieved_MFU / 0.45 — the reference's
-north-star MFU for Megatron-GPT2 under ZeRO, BASELINE.md), with an
-`extra` dict carrying the other BASELINE configs:
+Prints ONE JSON line. Headline: the FLAGSHIP config — GPT-2 1.5B
+(BASELINE.json "GPT-2 1.5B ZeRO-Stage-2") training tokens/s/chip with
+MFU reported top-level; `vs_baseline` = achieved_MFU / 0.45 (the
+reference's north-star MFU, BASELINE.md). On a 16 GB v5e chip the 1.5B
+state only fits via the bf16 master-less optimizer
+(`bf16 {"master_weights": false}` — runtime/bf16_optimizer.py: fp32
+Adam state would need 21.8 GB), which is the engine's intended flagship
+configuration on this hardware.
 
-  * BERT-large with the fused DeepSpeedTransformerLayer, seq 128 —
-    reference published 272 samples/s / 64 TFLOPS on 1x V100
-    (`docs/_tutorials/bert-pretraining.md:387`)
-  * 16k-context block-sparse attention vs dense flash attention —
-    reference claims up to 6.3x over dense (`docs/index.md:135`)
+`extra` carries the other BASELINE configs:
+  * GPT-2 350M (continuity with BENCH_r01/r02 headlines)
+  * BERT-large fused-layer seq128 (ref: 272 samples/s on 1x V100)
+  * 16k/32k block-sparse vs dense flash (ref claims up to 6.3x)
+  * a REAL ZeRO-Offload optimizer step (grads -> host CPU-Adam ->
+    params), with the measured host/transfer split
+  * GPT-2 13B ZeRO-3 memory plan (eval_shape arithmetic, no step)
+  * 1F1B interpreter vs SPMD pipe ratio on the same model
+
+Measurement notes (this chip is reached through a remote-dispatch
+tunnel and may be SHARED):
+  * warmup >= 6 steps — the first ~5 executions after compile run 2-4x
+    slow (donated buffers settle into the step's output layouts; the
+    axon path warms per-executable state), and timing them halves the
+    reported number
+  * the timed section runs 2 windows and keeps the best (guards
+    against transient contention on a shared chip)
+  * sync via device_get (block_until_ready can return early through
+    the tunnel)
 """
 
 import json
@@ -41,85 +59,137 @@ def _peak_flops(device) -> float:
     return 0.0  # unknown (e.g. CPU) -> MFU reported as 0
 
 
-def _run_engine(model, params, ds_config, make_batch, steps, warmup):
+def _sync(x):
+    float(jax.device_get(x))
+
+
+def _run_engine(model, params_box, ds_config, make_batch, steps, warmup,
+                windows=2):
+    """params_box: single-element list; popped so NO reference to the
+    caller's param tree survives engine init (the engine copies it, and
+    a dead 3.1 GB duplicate at 1.5B is the difference between fitting
+    16 GB HBM and OOM). Callers must `del` their own binding too."""
     from deepspeed_tpu import initialize
-    engine, _, _, _ = initialize(model=model, model_parameters=params,
+    engine, _, _, _ = initialize(model=model,
+                                 model_parameters=params_box.pop(),
                                  config=ds_config)
     for i in range(warmup):
         loss = engine.train_batch(batch=make_batch(i))
-    # device_get forces a true sync; block_until_ready alone can return
-    # early through remote-device tunnels
-    float(jax.device_get(loss))
-    t0 = time.perf_counter()
-    for i in range(steps):
-        loss = engine.train_batch(batch=make_batch(100 + i))
-    float(jax.device_get(loss))
-    return time.perf_counter() - t0
+    _sync(loss)
+    best = float("inf")
+    for w in range(windows):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = engine.train_batch(batch=make_batch(100 + i))
+        _sync(loss)
+        best = min(best, time.perf_counter() - t0)
+    return best, engine
 
 
-def bench_gpt2(on_tpu):
+def _gpt2_throughput(model_name, batch, seq, steps, warmup, ds_config,
+                     remat_policy=None):
+    import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
 
-    if on_tpu:
-        # Tuned on v5e-1: batch 16 + selective remat (save weight-matmul
-        # outputs, recompute elementwise) + chunked tied-head loss is the
-        # throughput sweet spot under the 16 GB HBM budget.
-        model_name, batch, seq, steps, warmup = "gpt2-350m", 16, 1024, 15, 3
-    else:  # CPU smoke path so the bench always emits a line (batch must
-        # divide the data axis of a virtual multi-device mesh; the toy
-        # size is named honestly in the metric)
-        model_name, batch, seq, steps, warmup = "gpt2-tiny-smoke", 8, 64, 2, 1
-
-    if on_tpu:
-        cfg = gpt2_config(model_name, n_positions=seq, dropout=0.0,
-                          remat=True,
-                          remat_policy="dots_with_no_batch_dims_saveable")
-    else:
-        from deepspeed_tpu.models.gpt2 import tiny_gpt2_config
-        cfg = tiny_gpt2_config(n_positions=seq, dropout=0.0)
+    cfg = gpt2_config(model_name, n_positions=seq, dropout=0.0,
+                      dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+                      remat=True, remat_policy=remat_policy)
     model = GPT2ForCausalLM(cfg)
-    rng = jax.random.PRNGKey(0)
-    params = model.init(rng, {"input_ids": np.zeros((batch, seq),
-                                                    np.int32)})
+    params = jax.jit(lambda r: model.init(
+        r, {"input_ids": np.zeros((batch, seq), np.int32)}))(
+        jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    box = [params]
+    del params
 
     def make_batch(i):
         ids = np.random.default_rng(i).integers(
             0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
         return {"input_ids": ids}
 
-    dt = _run_engine(model, params, {
-        "train_micro_batch_size_per_gpu": batch,
-        "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 0},
-        "optimizer": {"type": "AdamW",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-    }, make_batch, steps, warmup)
-
+    dt, _ = _run_engine(model, box, ds_config, make_batch, steps,
+                        warmup)
     n_chips = len(jax.devices())
     tokens_per_sec_per_chip = batch * seq * steps / dt / n_chips
-    n_params = sum(int(np.prod(l.shape))
-                   for l in jax.tree_util.tree_leaves(params))
     # 6ND model flops (standard convention; remat recompute not counted)
     achieved = tokens_per_sec_per_chip * 6.0 * n_params
     peak = _peak_flops(jax.devices()[0])
     mfu = achieved / peak if peak else 0.0
-    return model_name, tokens_per_sec_per_chip, mfu
+    return tokens_per_sec_per_chip, mfu, achieved
+
+
+def bench_gpt2_15b():
+    """Flagship: GPT-2 1.5B, ZeRO-2 + bf16 master-less state (the only
+    way 1.5B Adam state fits 16 GB HBM; BASELINE.json config 2)."""
+    return _gpt2_throughput(
+        "gpt2-1.5b", batch=8, seq=1024, steps=8, warmup=6,
+        ds_config={
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 1000,
+            "bf16": {"enabled": True, "master_weights": False},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        })
+
+
+def bench_gpt2_350m():
+    """Continuity config (BENCH_r01/r02 headline): GPT-2 350M, classic
+    bf16 + fp32 master, selective remat."""
+    tps, mfu, _ = _gpt2_throughput(
+        "gpt2-350m", batch=16, seq=1024, steps=10, warmup=6,
+        remat_policy="dots_with_no_batch_dims_saveable",
+        ds_config={
+            "train_micro_batch_size_per_gpu": 16,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 1000,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 0},
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        })
+    return {"tokens_per_sec_per_chip": round(tps, 1), "mfu": round(mfu, 4)}
+
+
+def bench_gpt2_cpu_smoke():
+    """CPU fallback so the bench always emits a line."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+    cfg = tiny_gpt2_config(n_positions=64, dropout=0.0)
+    model = GPT2ForCausalLM(cfg)
+    batch, seq = 8, 64
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((batch, seq), np.int32)})
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    box = [params]
+    del params
+    dt, _ = _run_engine(model, box, {
+        "train_micro_batch_size_per_gpu": batch,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+    }, make_batch, steps=2, warmup=1, windows=1)
+    tps = batch * seq * 2 / dt / len(jax.devices())
+    return tps, 0.0, 6.0 * n_params * tps
 
 
 def bench_bert_large():
     """BERT-large pretraining step with the fused transformer layer,
     seq 128 (the reference's headline kernel benchmark: 272 samples/s /
-    64 TFLOPS on 1x V100, bert-pretraining.md:387)."""
+    64 TFLOPS on 1x V100, bert-pretraining.md:387). Reported as
+    TFLOPS/chip + MFU against THIS chip's peak (the honest yardstick),
+    with the V100 ratio kept for reference."""
+    import jax.numpy as jnp
     from deepspeed_tpu.models.bert import BertForPreTrainingLM, bert_config
 
-    # micro 16 x gas 16 inside ONE fused jitted step: larger micro
-    # batches hit a compile-helper limit in this environment, and
-    # per-dispatch overhead through the device tunnel would otherwise
-    # dominate a seq-128 step
-    # warmup >= 2: the first step compiles, the SECOND recompiles once
-    # more (the initial device_put state and the step-output state carry
-    # different sharding representations); only then is the program hot
     batch, gas, seq, steps, warmup = 16, 16, 128, 3, 2
     cfg = bert_config("bert-large", max_position_embeddings=seq,
                       hidden_dropout_prob=0.0,
@@ -127,6 +197,8 @@ def bench_bert_large():
     model = BertForPreTrainingLM(cfg)
     example = {"input_ids": np.zeros((batch, seq), np.int32)}
     params = model.init(jax.random.PRNGKey(0), example)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
 
     def make_batch(i):
         r = np.random.default_rng(i)
@@ -138,20 +210,22 @@ def bench_bert_large():
                 "next_sentence_label": r.integers(
                     0, 2, (gas, batch)).astype(np.int32)}
 
-    dt = _run_engine(model, params, {
+    box = [params]
+    del params
+    dt, _ = _run_engine(model, box, {
         "train_micro_batch_size_per_gpu": batch,
         "gradient_accumulation_steps": gas,
         "bf16": {"enabled": True},
+        "steps_per_print": 1000,
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
     }, make_batch, steps, warmup)
 
-    # per-chip so the number stays comparable to the 1x V100 baseline
     samples_per_sec = batch * gas * steps / dt / len(jax.devices())
-    n_params = sum(int(np.prod(l.shape))
-                   for l in jax.tree_util.tree_leaves(params))
     tflops = samples_per_sec * seq * 6.0 * n_params / 1e12
+    peak = _peak_flops(jax.devices()[0])
     return {"samples_per_sec_per_chip": round(samples_per_sec, 1),
             "tflops_per_chip": round(tflops, 1),
+            "mfu": round(tflops * 1e12 / peak, 4) if peak else 0.0,
             "vs_v100_published": round(samples_per_sec / 272.0, 2)}
 
 
@@ -169,25 +243,30 @@ def bench_sparse_16k():
     h, d = 16, 64
     rng = np.random.default_rng(0)
     out = {}
+
+    def timed(fn, q):
+        grad = jax.jit(lambda q: jax.grad(
+            lambda q: fn(q).astype(jnp.float32).sum())(q).sum())
+        for _ in range(3):
+            r = grad(q)
+        _sync(r)
+        best = float("inf")
+        for w in range(2):
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = grad(q)
+            _sync(r)
+            best = min(best, (time.perf_counter() - t0) / 5)
+        return best
+
     for b, t in ((1, 16384), (2, 32768)):
         q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
         sparse = SparseSelfAttention(
             FixedSparsityConfig(num_heads=h, block=256,
                                 num_local_blocks=4, num_global_blocks=1),
             max_seq_length=t)
-
-        def timed(fn):
-            grad = jax.jit(lambda q: jax.grad(
-                lambda q: fn(q).astype(jnp.float32).sum())(q).sum())
-            float(jax.device_get(grad(q)))  # compile + true sync
-            t0 = time.perf_counter()
-            for _ in range(5):
-                r = grad(q)
-            float(jax.device_get(r))
-            return (time.perf_counter() - t0) / 5
-
-        t_sparse = timed(lambda q: sparse(q, q, q, causal=True))
-        t_dense = timed(lambda q: flash_attention(q, q, q, causal=True))
+        t_sparse = timed(lambda q: sparse(q, q, q, causal=True), q)
+        t_dense = timed(lambda q: flash_attention(q, q, q, causal=True), q)
         out[f"seq{t}"] = {
             "sparse_ms": round(t_sparse * 1e3, 2),
             "dense_flash_ms": round(t_dense * 1e3, 2),
@@ -195,8 +274,7 @@ def bench_sparse_16k():
 
     # reference-style comparator (materialized-scores dense attention,
     # what the 6.3x claim was measured against); it cannot even compile
-    # past 8k here, which IS the '10x longer sequences' story. Its own
-    # try/except: a naive-dense OOM must not discard the results above.
+    # past 8k here, which IS the '10x longer sequences' story.
     try:
         from deepspeed_tpu.ops.transformer.flash_attention import \
             dense_attention
@@ -206,8 +284,8 @@ def bench_sparse_16k():
             FixedSparsityConfig(num_heads=h, block=256,
                                 num_local_blocks=4, num_global_blocks=1),
             max_seq_length=t)
-        t_sparse = timed(lambda q: sparse(q, q, q, causal=True))
-        t_naive = timed(lambda q: dense_attention(q, q, q, causal=True))
+        t_sparse = timed(lambda q: sparse(q, q, q, causal=True), q)
+        t_naive = timed(lambda q: dense_attention(q, q, q, causal=True), q)
         out["seq8192_vs_naive_dense"] = {
             "sparse_ms": round(t_sparse * 1e3, 2),
             "naive_dense_ms": round(t_naive * 1e3, 2),
@@ -216,6 +294,141 @@ def bench_sparse_16k():
         out["seq8192_vs_naive_dense"] = {
             "error": f"{type(e).__name__}: {e}"[:200]}
     return out
+
+
+def bench_offload_real_step():
+    """A REAL ZeRO-Offload optimizer step (BASELINE/ref claim: 13B on
+    one device via host-offloaded Adam): GPT-2 125M, bf16 grads ->
+    host, native CPU-Adam, bf16 params back. Reports the measured
+    end-to-end optimizer-step wall time and the compute-side
+    throughput, plus the split — on this environment the host link is
+    a ~20 MB/s remote tunnel, so the transfer dominates and the
+    interesting number is that the path RUNS and the compute side
+    keeps its throughput. gas amortizes the host step as in real use."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
+    from deepspeed_tpu import initialize
+
+    batch, seq, gas = 8, 1024, 4
+    cfg = gpt2_config("gpt2-125m", n_positions=seq, dropout=0.0,
+                      dtype=jnp.bfloat16, param_dtype=jnp.float32,
+                      remat=True)
+    model = GPT2ForCausalLM(cfg)
+    params = jax.jit(lambda r: model.init(
+        r, {"input_ids": np.zeros((batch, seq), np.int32)}))(
+        jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    engine, _, _, _ = initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": batch,
+            "gradient_accumulation_steps": gas,
+            "steps_per_print": 1000,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2, "cpu_offload": True},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        })
+    del params
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (gas, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    # one warmup (compiles grads program + host step)
+    engine.train_batch(batch=make_batch(0))
+    t0 = time.perf_counter()
+    loss = engine.train_batch(batch=make_batch(1))
+    _sync(loss)
+    step_s = time.perf_counter() - t0
+    tokens = batch * seq * gas
+    return {"model": "gpt2-125m", "params_m": round(n_params / 1e6, 1),
+            "gas": gas,
+            "measured_step_s": round(step_s, 2),
+            "tokens_per_sec": round(tokens / step_s, 1),
+            "tflops_per_chip": round(6.0 * n_params * tokens / step_s / 1e12,
+                                     2),
+            "note": "host link is a ~10-20 MB/s remote tunnel here, so "
+                    "transfer dominates and model size is kept small to "
+                    "bound bench time; capability at scale is the ZeRO-3 "
+                    "memory plan + offload test suite"}
+
+
+def bench_pipe_interp_vs_spmd():
+    """Same homogeneous model through the compiled 1F1B interpreter vs
+    the SPMD scan fast path. Pipeline parallelism needs pipe >= 2;
+    with one real chip the comparison runs in a subprocess on an
+    8-device virtual CPU mesh — the RATIO (schedule efficiency) is the
+    metric, not absolute time."""
+    import subprocess
+    import sys
+    script = r"""
+import os, json, time
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np, jax.numpy as jnp
+import deepspeed_tpu
+from deepspeed_tpu.runtime.mesh import build_mesh
+from deepspeed_tpu.runtime.pipe.module import PipelineModule, LayerSpec
+from deepspeed_tpu.models.gpt2 import GPT2Block, tiny_gpt2_config
+from deepspeed_tpu.models.gpt2_pipe import PipelinedGPT2
+
+L, S, GAS, MB, T = 8, 4, 8, 4, 128
+cfg = tiny_gpt2_config(n_layer=L, n_embd=128, n_head=4, n_positions=T)
+mesh = build_mesh({'pipe': S, 'data': 8 // S, 'model': 1})
+ds = {'train_micro_batch_size_per_gpu': MB,
+      'gradient_accumulation_steps': GAS, 'steps_per_print': 1000,
+      'optimizer': {'type': 'Adam', 'params': {'lr': 1e-3}}}
+rng0 = np.random.RandomState(0)
+out = {}
+
+def run(e, batches, warm=2, n=6):
+    for i in range(warm):
+        l = e.train_batch(batch=batches(i))
+    float(jax.device_get(l))
+    t0 = time.perf_counter()
+    for i in range(n):
+        l = e.train_batch(batch=batches(i))
+    float(jax.device_get(l))
+    return (time.perf_counter() - t0) / n * 1e3
+
+# SPMD fast path: PipelinedGPT2 (transformer compute = L GPT2Blocks)
+mp = PipelinedGPT2(cfg, num_stages=S, num_micro_batches=GAS)
+ids = rng0.randint(0, cfg.vocab_size, (MB * GAS, T)).astype(np.int32)
+pp = mp.init(jax.random.PRNGKey(0), {'input_ids': ids})
+e1, _, _, _ = deepspeed_tpu.initialize(model=mp, model_parameters=pp,
+                                       config=ds, mesh=mesh)
+out['spmd_ms'] = round(run(e1, lambda i: {'input_ids': ids}), 1)
+
+# compiled 1F1B interpreter: PipelineModule of the SAME GPT2Blocks
+# (hidden-space in/out; embed/head excluded on both sides' delta)
+mod = PipelineModule([LayerSpec(GPT2Block, cfg) for _ in range(L)],
+                     num_stages=S,
+                     loss_fn=lambda y, lab: jnp.mean(
+                         (y - lab).astype(jnp.float32) ** 2))
+x0 = rng0.randn(MB, T, 128).astype(np.float32)
+prm = mod.init_params(jax.random.PRNGKey(0), jnp.asarray(x0))
+e2, _, _, _ = deepspeed_tpu.initialize(model=mod, model_parameters=prm,
+                                       config=ds, mesh=mesh)
+xb = rng0.randn(MB * GAS, T, 128).astype(np.float32)
+out['interp_ms'] = round(run(e2, lambda i: {'x': xb, 'y': xb * 0.5}), 1)
+out['interp_used'] = e2._interp_fn is not None
+out['interp_over_spmd'] = round(out['interp_ms'] / out['spmd_ms'], 2)
+print('RESULT:' + json.dumps(out))
+"""
+    env = dict(__import__("os").environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        proc = subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True, timeout=900)
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT:"):
+                return json.loads(line[len("RESULT:"):])
+        return {"error": (proc.stderr or proc.stdout)[-200:]}
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 def bench_13b_memory_plan():
@@ -271,13 +484,24 @@ def bench_13b_memory_plan():
 
 def main():
     on_tpu = jax.devices()[0].platform == "tpu"
-    model_name, tps, mfu = bench_gpt2(on_tpu)
+    if on_tpu:
+        model_name = "gpt2-1.5b"
+        tps, mfu, achieved = bench_gpt2_15b()
+    else:
+        model_name = "gpt2-tiny-smoke"
+        tps, mfu, achieved = bench_gpt2_cpu_smoke()
 
-    extra = {"gpt2_mfu": round(mfu, 4)}
+    extra = {"flagship_config": "GPT-2 1.5B ZeRO-2, bf16 master-less "
+                                "(fp32 Adam state = 21.8 GB > 16 GB HBM)",
+             "achieved_tflops_per_chip": round(achieved / 1e12, 1)}
     extras = [("gpt2_13b_zero3_memory_plan", bench_13b_memory_plan)]
     if on_tpu:
-        extras = [("bert_large_fused_seq128", bench_bert_large),
-                  ("sparse_attention_16k", bench_sparse_16k)] + extras
+        extras = [("gpt2_350m", bench_gpt2_350m),
+                  ("bert_large_fused_seq128", bench_bert_large),
+                  ("sparse_attention_16k", bench_sparse_16k),
+                  ("zero_offload_real_step", bench_offload_real_step),
+                  ("pipe_interp_vs_spmd", bench_pipe_interp_vs_spmd),
+                  ] + extras
     for name, fn in extras:
         try:
             extra[name] = fn()
@@ -288,6 +512,7 @@ def main():
         "metric": f"{model_name}_train_tokens_per_sec_per_chip",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
+        "mfu": round(mfu, 4),
         "vs_baseline": round(mfu / 0.45, 4),
         "extra": extra,
     }))

@@ -8,6 +8,9 @@ from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import (
     SparseSelfAttention, BertSparseSelfAttention)
 from deepspeed_tpu.ops.sparse_attention.sparse_attention_utils import (
     SparseAttentionUtils)
+from deepspeed_tpu.ops.sparse_attention.matmul import (MatMul, to_sparse,
+                                                       to_dense)
+from deepspeed_tpu.ops.sparse_attention.softmax import Softmax
 
 __all__ = [
     "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
@@ -15,4 +18,5 @@ __all__ = [
     "BSLongformerSparsityConfig", "block_sparse_attention",
     "layout_to_dense_mask", "SparseSelfAttention",
     "BertSparseSelfAttention", "SparseAttentionUtils",
+    "MatMul", "Softmax", "to_sparse", "to_dense",
 ]

@@ -175,9 +175,17 @@ def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref, k_ref,
 
     @pl.when(st == kmax - 1)
     def _():
-        l = jnp.maximum(l_scr[:, :, :1], 1e-30)
-        o_ref[...] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
+        l = l_scr[:, :, :1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        # A member row with ZERO visible entries (possible inside a
+        # super-row whose union has blocks only for sibling rows) must
+        # export lse=+inf, not NEG_INF+log(1e-30): the backward kernels
+        # compute p=exp(s-lse) and only +inf sends every masked score to
+        # exactly 0 (delta=0 does not cancel the dp term).
+        lse_ref[...] = jnp.where(l > 0.0,
+                                 m_scr[:, :, :1] + jnp.log(l_safe),
+                                 jnp.inf)
 
 
 def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, qmask_ref, q_ref,

@@ -1,0 +1,149 @@
+"""Standalone block-sparse MatMul — the reusable primitive behind the
+fused attention kernel.
+
+Counterpart of the reference's Triton block-sparse matmul
+(`deepspeed/ops/sparse_attention/matmul.py:16-750`): same three modes
+over the same data format —
+
+    sdd   sparse = dense  x dense
+    dsd   dense  = sparse x dense
+    dds   dense  = dense  x sparse
+
+with dense tensors shaped [batch, heads, M, N] and sparse tensors in
+the compact block format [batch, nnz, block, block], where nnz
+enumerates `layout.nonzero()` in (head, block_row, block_col)
+lexicographic order (the reference's LUT order).
+
+TPU-native form: instead of compiling Triton LUT kernels, the nonzero
+blocks become ONE batched einsum over a gathered [batch, nnz, ...]
+operand (every block is an MXU tile), and dense outputs reduce with
+`segment_sum` over the nnz axis. Everything is plain jax — autodiff
+provides the dA/dB programs that the reference hand-assembles from
+`make_dxx_lut`/`make_sdd_lut` tables, and `jit` caches the compiled
+kernels the way the reference caches LUTs. Gather/scatter indices are
+numpy constants baked at trace time (layouts are static per config).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _layout_indices(layout):
+    """layout [H, R, C] -> (h_idx, r_idx, c_idx) in the reference's
+    lexicographic nonzero order."""
+    lay = np.asarray(layout)
+    if lay.ndim != 3:
+        raise ValueError(f"layout must be [heads, rows, cols] 3-D, got "
+                         f"shape {lay.shape}")
+    h, r, c = np.nonzero(lay)
+    return (h.astype(np.int32), r.astype(np.int32), c.astype(np.int32))
+
+
+def _seg_sum(data, seg_ids, num_segments):
+    """segment_sum over axis 1 (the nnz axis) of [B, nnz, ...]."""
+    moved = jnp.moveaxis(data, 1, 0)
+    out = jax.ops.segment_sum(moved, jnp.asarray(seg_ids),
+                              num_segments=num_segments)
+    return jnp.moveaxis(out, 0, 1)
+
+
+def to_sparse(dense, layout, block):
+    """[B, H, R*block, C*block] dense -> [B, nnz, block, block] compact
+    (the inverse of `to_dense`; test/interop helper)."""
+    h, r, c = _layout_indices(layout)
+    b = dense.shape[0]
+    H, R, C = np.asarray(layout).shape
+    x = dense.reshape(b, H, R, block, C, block)
+    return x.transpose(0, 1, 2, 4, 3, 5)[:, h, r, c]
+
+
+def to_dense(sparse, layout, block, fill=0.0):
+    """[B, nnz, block, block] compact -> [B, H, R*block, C*block]."""
+    h, r, c = _layout_indices(layout)
+    H, R, C = np.asarray(layout).shape
+    b = sparse.shape[0]
+    out = jnp.full((b, H * R * C, block, block), fill, sparse.dtype)
+    flat_idx = (h.astype(np.int64) * R * C + r.astype(np.int64) * C +
+                c.astype(np.int64))
+    out = out.at[:, flat_idx].set(sparse)
+    out = out.reshape(b, H, R, C, block, block)
+    return out.transpose(0, 1, 2, 4, 3, 5).reshape(
+        b, H, R * block, C * block)
+
+
+class MatMul:
+    """Block-sparse matmul over a fixed layout (ref `matmul.py:616`).
+
+    Arguments match the reference: layout [heads, blocks, blocks] 0/1;
+    block size; mode in {'sdd','dsd','dds'}; trans_a/trans_b transpose
+    the corresponding operand (for the sparse operand this transposes
+    each block AND swaps its row/column placement — the layout the
+    caller passes is always the layout of the UNtransposed operand)."""
+
+    def __init__(self, layout, block, mode, trans_a=False, trans_b=False):
+        if mode not in ("sdd", "dsd", "dds"):
+            raise NotImplementedError("Supported modes are: sdd, dsd, dds")
+        self.layout = np.asarray(layout)
+        self.block = int(block)
+        self.mode = mode
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+        self.spdims = self.layout.shape
+        self._h, self._r, self._c = _layout_indices(self.layout)
+
+    # -- gathers ---------------------------------------------------------
+    def _dense_rows(self, x, h, r):
+        """x [B, H, M, K] -> [B, nnz, block, K] (block-rows r of head h)."""
+        b, H, m, k = x.shape
+        xr = x.reshape(b, H, m // self.block, self.block, k)
+        return xr[:, h, r]
+
+    def _dense_cols(self, x, h, c):
+        """x [B, H, K, N] -> [B, nnz, K, block] (block-cols c of head h)."""
+        b, H, k, n = x.shape
+        xc = x.reshape(b, H, k, n // self.block, self.block)
+        return jnp.moveaxis(xc, 3, 2)[:, h, c]
+
+    def __call__(self, a, b):
+        bs = self.block
+        H, R, C = self.spdims
+        h, r, c = self._h, self._r, self._c
+
+        if self.mode == "sdd":
+            ad = jnp.swapaxes(a, -1, -2) if self.trans_a else a
+            bd = jnp.swapaxes(b, -1, -2) if self.trans_b else b
+            a_r = self._dense_rows(ad, h, r)           # [B, z, bs, K]
+            b_c = self._dense_cols(bd, h, c)           # [B, z, K, bs]
+            return jnp.einsum("bzik,bzkj->bzij", a_r, b_c,
+                              preferred_element_type=a_r.dtype)
+
+        if self.mode == "dsd":
+            # a sparse [B, nnz, bs, bs]; out rows follow a's layout rows
+            # (or cols when trans_a)
+            blk = jnp.swapaxes(a, -1, -2) if self.trans_a else a
+            row, col = (c, r) if self.trans_a else (r, c)
+            nrows = C if self.trans_a else R
+            bd = jnp.swapaxes(b, -1, -2) if self.trans_b else b
+            b_r = self._dense_rows(bd, h, col)         # [B, z, bs, N]
+            prod = jnp.einsum("bzij,bzjn->bzin", blk, b_r,
+                              preferred_element_type=blk.dtype)
+            out = _seg_sum(prod, h.astype(np.int64) * nrows + row,
+                           H * nrows)                  # [B, H*nr, bs, N]
+            bsz, _, _, n = prod.shape
+            return out.reshape(bsz, H, nrows * bs, n)
+
+        # dds: b sparse; out cols follow b's layout cols (or rows when
+        # trans_b)
+        blk = jnp.swapaxes(b, -1, -2) if self.trans_b else b
+        row, col = (c, r) if self.trans_b else (r, c)
+        ncols = R if self.trans_b else C
+        ad = jnp.swapaxes(a, -1, -2) if self.trans_a else a
+        a_c = self._dense_cols(ad, h, row)             # [B, z, M, bs]
+        prod = jnp.einsum("bzmi,bzin->bzmn", a_c, blk,
+                          preferred_element_type=a_c.dtype)
+        out = _seg_sum(prod, h.astype(np.int64) * ncols + col,
+                       H * ncols)                      # [B, H*nc, M, bs]
+        bsz, _, m, _ = prod.shape
+        out = out.reshape(bsz, H, ncols, m, bs)
+        return jnp.moveaxis(out, 2, 3).reshape(bsz, H, m, ncols * bs)

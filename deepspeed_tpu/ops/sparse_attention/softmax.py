@@ -1,0 +1,85 @@
+"""Standalone block-sparse Softmax over the compact block format.
+
+Counterpart of the reference's Triton sparse softmax
+(`deepspeed/ops/sparse_attention/softmax.py:17-304`): normalizes each
+QUERY ROW across every visible key block of that row in a
+[batch, nnz, block, block] tensor, with the same optional masks —
+relative position embedding, key padding mask [B, seq], attention mask
+[seq, seq], each in 'add' or 'mul' mode.
+
+TPU-native form: a row's blocks are scattered along the nnz axis, so
+the row-wise max/sum become `segment_max`/`segment_sum` keyed by
+(head, block_row) — the XLA analogue of the reference's LUT-driven
+reduction (`make_lut`, `softmax.py:66-86`). Pure jax: autodiff supplies
+the backward (the reference hand-writes the y*(dy - sum(y*dy)) kernel,
+`softmax.py:157-183`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.ops.sparse_attention.matmul import _layout_indices
+
+_NEG = -1e30
+
+
+class Softmax:
+    """Block-sparse softmax over a fixed layout (ref `softmax.py:219`)."""
+
+    def __init__(self, layout, block):
+        self.layout = np.asarray(layout)
+        self.block = int(block)
+        self.spdims = self.layout.shape
+        self._h, self._r, self._c = _layout_indices(self.layout)
+
+    def __call__(self, x, scale=1.0, rpe=None, key_padding_mask=None,
+                 attn_mask=None, key_padding_mask_mode="add",
+                 attn_mask_mode="add"):
+        """x: [B, nnz, block, block] scores in compact block format.
+
+        scale multiplies x first; rpe (broadcastable to x, compact
+        format) adds; key_padding_mask [B, seq_k] and attn_mask
+        [seq_q, seq_k] apply per their mode ('add' before softmax, or
+        'mul' zeroing: 0-entries become -inf). Rows with no surviving
+        entries return 0 probabilities (not NaN)."""
+        bs = self.block
+        H, R, C = self.spdims
+        h, r, c = self._h, self._r, self._c
+        xs = x.astype(jnp.float32) * scale
+        if rpe is not None:
+            xs = xs + rpe.astype(jnp.float32)
+
+        if key_padding_mask is not None:
+            # gather each block's key columns: [B, nnz, bs]
+            kpm = key_padding_mask.astype(jnp.float32)
+            kcols = kpm.reshape(kpm.shape[0], C, bs)[:, c]
+            if key_padding_mask_mode == "add":
+                xs = xs + kcols[:, :, None, :]
+            else:
+                xs = jnp.where(kcols[:, :, None, :] == 0, _NEG, xs)
+        if attn_mask is not None:
+            am = attn_mask.astype(jnp.float32)
+            blocks = am.reshape(R, bs, C, bs).transpose(0, 2, 1, 3)[r, c]
+            if attn_mask_mode == "add":
+                xs = xs + blocks[None]
+            else:
+                xs = jnp.where(blocks[None] == 0, _NEG, xs)
+
+        # row-wise softmax across this row's blocks (segment over nnz)
+        seg = jnp.asarray(h.astype(np.int64) * R + r)
+        G = H * R
+        rowmax = jnp.max(xs, axis=-1)                       # [B, z, bs]
+        gmax = jax.ops.segment_max(jnp.moveaxis(rowmax, 1, 0), seg,
+                                   num_segments=G)          # [G, B, bs]
+        gmax = jnp.maximum(gmax, _NEG)   # empty/all-masked rows
+        p = jnp.exp(xs - jnp.moveaxis(gmax, 0, 1)[:, seg][..., None])
+        # entries pushed to -inf by a mask contribute 0 probability even
+        # when the whole row is masked (gmax saturates at _NEG there and
+        # exp(0) would otherwise resurrect them)
+        p = jnp.where(xs > _NEG / 2, p, 0.0)
+        rowsum = jnp.sum(p, axis=-1)                        # [B, z, bs]
+        gsum = jax.ops.segment_sum(jnp.moveaxis(rowsum, 1, 0), seg,
+                                   num_segments=G)
+        denom = jnp.moveaxis(gsum, 0, 1)[:, seg][..., None]
+        p = jnp.where(denom > 0, p / jnp.maximum(denom, 1e-30), 0.0)
+        return p.astype(x.dtype)

@@ -48,6 +48,40 @@ _SHARD_RE = re.compile(
 
 
 # ----------------------------------------------------------------------
+# npz-safe dtype encoding (np.savez silently degrades ml_dtypes arrays
+# — bf16 etc. — to raw void records; store them as same-width uints and
+# record the logical dtype in the manifest / shard meta)
+# ----------------------------------------------------------------------
+def _np_dtype(name):
+    """np.dtype from a string, resolving ml_dtypes names ("bfloat16",
+    "float8_e4m3fn", ...) that plain numpy does not know."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _npz_encode(arr):
+    """array -> (npz-native array, logical dtype string or None)."""
+    arr = np.asarray(arr)
+    try:
+        np.dtype(arr.dtype.name)   # round-trippable by plain numpy?
+        if arr.dtype.kind != "V":
+            return arr, None
+    except TypeError:
+        pass
+    uint = np.dtype(f"u{arr.dtype.itemsize}")
+    return arr.view(uint), arr.dtype.name
+
+
+def _npz_decode(arr, dtype_name):
+    if dtype_name is None:
+        return arr
+    return arr.view(_np_dtype(dtype_name))
+
+
+# ----------------------------------------------------------------------
 # pytree <-> flat path/leaf maps
 # ----------------------------------------------------------------------
 def tree_to_entries(tree, prefix=""):
@@ -122,10 +156,12 @@ def _write_shard_buckets(ckpt_dir, fmt, sharded, mp_rank=0):
             name = f"s{len(bucket_meta.get(ordinal, []))}"
             start = [0 if sl.start is None else int(sl.start)
                      for sl in shard.index]
-            buckets.setdefault(ordinal, {})[name] = np.asarray(shard.data)
+            piece, enc = _npz_encode(np.asarray(shard.data))
+            buckets.setdefault(ordinal, {})[name] = piece
             bucket_meta.setdefault(ordinal, []).append({
                 "name": name, "key": key, "start": start,
                 "global_shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                "npz_dtype": enc,
             })
     for ordinal, arrays in buckets.items():
         base = os.path.join(ckpt_dir, fmt.format(ordinal, mp_rank))
@@ -205,8 +241,12 @@ def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0):
 
     meta = {k: v for k, v in model_sd.items() if k != "module"}
     main = {}
+    npz_dtypes = {}
     for key, leaf in mod_repl + opt_repl:
-        main[key] = np.asarray(jax.device_get(leaf))
+        arr, enc = _npz_encode(np.asarray(jax.device_get(leaf)))
+        main[key] = arr
+        if enc is not None:
+            npz_dtypes[key] = enc
     base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
     np.savez(base + ".npz", **main)
     with open(base + ".json", "w") as f:
@@ -214,6 +254,7 @@ def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0):
             "format_version": FORMAT_VERSION,
             "meta": _json_safe(meta),
             "optim_meta": _json_safe(opt_meta),
+            "npz_dtypes": npz_dtypes,
             "has_optim": optim_sd is not None,
         }, f)
 
@@ -230,9 +271,10 @@ def _assemble(flat, shard_entries):
     for key, pieces in by_key.items():
         _, first = pieces[0]
         out = np.zeros(first["global_shape"],
-                       dtype=np.dtype(first["dtype"]))
+                       dtype=_np_dtype(first["dtype"]))
         for npz, entry in pieces:
-            piece = npz[entry["name"]]
+            piece = _npz_decode(npz[entry["name"]],
+                                entry.get("npz_dtype"))
             idx = tuple(slice(s, s + d) for s, d in
                         zip(entry["start"], piece.shape))
             out[idx] = piece
@@ -266,10 +308,11 @@ def load_checkpoint_flat(load_dir, tag, mp_rank=0):
     base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
     with open(base + ".json") as f:
         manifest = json.load(f)
+    npz_dtypes = manifest.get("npz_dtypes", {})
     flat = {}
     with np.load(base + ".npz") as main:
         for key in main.files:
-            flat[key] = main[key]
+            flat[key] = _npz_decode(main[key], npz_dtypes.get(key))
 
     shard_entries = []
     opened = []

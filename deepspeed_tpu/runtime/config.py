@@ -49,6 +49,15 @@ def get_bfloat16_enabled(param_dict):
     return False
 
 
+def get_bfloat16_master_weights(param_dict):
+    for key in (C.BFLOAT16, C.BFLOAT16_ALIAS):
+        if key in param_dict:
+            return get_scalar_param(param_dict[key],
+                                    C.BFLOAT16_MASTER_WEIGHTS,
+                                    C.BFLOAT16_MASTER_WEIGHTS_DEFAULT)
+    return C.BFLOAT16_MASTER_WEIGHTS_DEFAULT
+
+
 def get_loss_scale(param_dict):
     if get_fp16_enabled(param_dict):
         return get_scalar_param(param_dict[C.FP16], C.FP16_LOSS_SCALE,
@@ -376,6 +385,8 @@ class DeepSpeedConfig:
 
         self.fp16_enabled = get_fp16_enabled(param_dict)
         self.bfloat16_enabled = get_bfloat16_enabled(param_dict)
+        self.bfloat16_master_weights = get_bfloat16_master_weights(
+            param_dict)
         # Apex AMP parity (ref config.py:66-77): meaningless on TPU —
         # map "amp": {"enabled": true} to bf16 mixed precision, which
         # is the hardware's native fast dtype

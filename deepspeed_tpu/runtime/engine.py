@@ -157,7 +157,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self.bf16_mode = self._config.bfloat16_enabled
         self.compute_dtype = (jnp.float16 if self.fp16_mode else
                               jnp.bfloat16 if self.bf16_mode else jnp.float32)
-        self.mixed_precision = self.fp16_mode or self.bf16_mode
+        # bf16 {"master_weights": false}: no fp32 master, bf16 Adam
+        # moments, stochastic-rounded param writes
+        # (runtime/bf16_optimizer.py) — 6 B/param of optimizer state
+        # instead of mixed precision's 16 B/param.
+        self.bf16_sr_mode = (self.bf16_mode and
+                             not self._config.bfloat16_master_weights and
+                             not (self.zero_optimization() and
+                                  self.zero_cpu_offload()))
+        self.mixed_precision = (self.fp16_mode or self.bf16_mode) and \
+            not self.bf16_sr_mode
         self.dynamic_loss_scale_enabled = self.fp16_mode and \
             self._config.loss_scale == 0
 
@@ -463,6 +472,25 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         weight_decay = params.get("weight_decay", 0.0)
         self._base_lr = lr
 
+        if self.bf16_sr_mode:
+            # Master-less bf16: moments live in bf16, update math in
+            # fp32, param write-back stochastically rounded
+            # (runtime/bf16_optimizer.py). Adam/AdamW only — the other
+            # optimizers keep the fp32-master path.
+            if name not in (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER):
+                raise ValueError(
+                    f'bf16 {{"master_weights": false}} supports '
+                    f"Adam/AdamW only (got {name!r}); drop the flag to "
+                    "use the fp32-master path")
+            from deepspeed_tpu.runtime.bf16_optimizer import adamw_bf16
+            if weight_decay and not params.get("adam_w_mode", True) and \
+                    name != C.ADAMW_OPTIMIZER:
+                logger.warning(
+                    "bf16 master_weights=false uses decoupled (AdamW) "
+                    "weight decay; adam_w_mode=false is ignored")
+            return adamw_bf16(learning_rate=lr, b1=betas[0], b2=betas[1],
+                              eps=eps, weight_decay=weight_decay)
+
         if name == C.ONEBIT_ADAM_OPTIMIZER:
             # 1-bit Adam (ref onebit_adam.py:18): freeze_step warmup then
             # sign-compressed momentum with error feedback. On a
@@ -577,10 +605,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # it, and the step donates its input state — without the copy the
         # caller's (possibly shared) initial params would be invalidated
         # after the first step.
-        params_f32 = jax.tree_util.tree_map(
-            lambda x: jnp.array(x, dtype=jnp.float32, copy=True)
-            if isinstance(x, jax.Array)
-            else jnp.asarray(x, jnp.float32), self._initial_params)
+        # In SR mode no state group stores fp32 values, so the fp32 tree
+        # stays ABSTRACT (at 1.5B params a concrete fp32 copy is 6.2 GB
+        # of HBM that would sit next to the real state just long enough
+        # to OOM the first step).
+        if self.bf16_sr_mode:
+            params_f32 = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(np.shape(x), jnp.float32),
+                self._initial_params)
+        else:
+            params_f32 = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, dtype=jnp.float32, copy=True)
+                if isinstance(x, jax.Array)
+                else jnp.asarray(x, jnp.float32), self._initial_params)
 
         tp_specs = None
         if hasattr(self.module, "tp_param_specs"):
@@ -613,15 +650,26 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._acc_shardings = self.zero_policy.grad_accum_shardings(params_enc)
         self._params_enc_template = params_enc
 
-        if self.mixed_precision or self._offload_enabled():
+        if self.bf16_sr_mode:
+            # cast straight from the caller's params — no fp32 detour;
+            # copy=True keeps the donation contract (same-dtype asarray
+            # of a device array would alias it)
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    jnp.array(x, dtype=self.compute_dtype, copy=True)
+                    if isinstance(x, jax.Array)
+                    else jnp.asarray(x, self.compute_dtype), s),
+                self._initial_params, self._param_shardings)
+            master = None
+        elif self.mixed_precision or self._offload_enabled():
             params = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(
                     jnp.asarray(x, self.compute_dtype), s),
                 params_f32, self._param_shardings)
-            # the fp32 master goes to device only when NOT offloading —
-            # offload's whole point is keeping it in host RAM
-            master = None if self._offload_enabled() else \
-                jax.device_put(params_enc, self._master_shardings)
+            # the fp32 master goes to device only in true mixed
+            # precision — offload keeps it in host RAM
+            master = jax.device_put(params_enc, self._master_shardings) \
+                if self.mixed_precision else None
         else:
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
@@ -645,6 +693,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 f"zero_stage={self.zero_optimization_stage()}, "
                 f"dtype={self.compute_dtype.__name__}, "
                 f"mesh={dict(self.mesh.shape)}", ranks=[0])
+            self._initial_params = None   # don't pin the caller's copy
             return
 
         opt_target = master if self.mixed_precision else params
@@ -702,6 +751,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             f"zero_stage={self.zero_optimization_stage()}, "
             f"dtype={self.compute_dtype.__name__}, "
             f"mesh={dict(self.mesh.shape)}", ranks=[0])
+        self._initial_params = None   # don't pin the caller's copy
 
     # ------------------------------------------------------------------
     # jitted step functions
@@ -723,8 +773,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         grad_fn = jax.value_and_grad(self._scaled_loss_fn, has_aux=True)
         (_, raw_loss), grads = grad_fn(params, batch, rng, loss_scale,
                                        keep_prob)
-        grads = jax.tree_util.tree_map(
-            lambda g: g.astype(jnp.float32), grads)
+        if not (self.bf16_sr_mode and self._jit_gas() == 1):
+            # fp32 grads for accumulation / the fp32-master update. In
+            # SR mode at gas=1 they stay in compute dtype: the update
+            # math casts per-leaf inside its fused elementwise chain,
+            # and a whole-tree fp32 cast here would MATERIALIZE a
+            # params-sized fp32 tree (6.2 GB at 1.5B) at peak memory.
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads)
         # pad-plan leaves: grads join the encoded (padded) layout here so
         # accumulator/master/update shapes all agree; padding is zeros
         grads = self.zero_policy.encode(grads, self._zero_pad_plan)
@@ -835,14 +891,33 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             opt_state = self._with_lr(opt_state, lr)
             updates, new_opt = transform.update(
                 grads, opt_state, target)
-            new_target = optax.apply_updates(target, updates)
+            if self.bf16_sr_mode:
+                # fp32 updates land on bf16 params via stochastic
+                # rounding — a deterministic bf16 add would swallow
+                # updates below ulp(p) (bf16_optimizer.py docstring)
+                from deepspeed_tpu.runtime.bf16_optimizer import \
+                    stochastic_round_apply
+                key = jax.random.fold_in(jax.random.PRNGKey(17),
+                                         state.global_steps)
+                new_target = stochastic_round_apply(target, updates, key)
+            else:
+                new_target = optax.apply_updates(target, updates)
             return new_target, new_opt
 
         def skip_update(target, opt_state):
             return target, opt_state
 
-        new_target, new_opt = jax.lax.cond(
-            overflow, skip_update, do_update, opt_target, state.opt_state)
+        if self.fp16_mode:
+            new_target, new_opt = jax.lax.cond(
+                overflow, skip_update, do_update, opt_target,
+                state.opt_state)
+        else:
+            # overflow is statically False without fp16 loss scaling —
+            # a lax.cond here would keep BOTH branches' outputs alive
+            # (the skip branch returns the old params), blocking buffer
+            # donation of params/opt_state into the update at exactly
+            # the step's peak-memory point
+            new_target, new_opt = do_update(opt_target, state.opt_state)
 
         if self.mixed_precision:
             new_master = new_target if local_axis is not None else \
@@ -1456,22 +1531,32 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             sd["module"] = self.module.load_state_dir(
                 os.path.join(load_dir, str(tag)), self.state.params)
 
-        params_f32 = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, jnp.float32), sd["module"])
         # Under ZeRO-Offload the fp32 master lives in pinned host memory
         # (state.master is None); rebuilding a device master here would
-        # defeat offload and risk OOM (mirrors _init_state).
-        if self.mixed_precision or self._offload_enabled():
+        # defeat offload and risk OOM (mirrors _init_state). SR mode
+        # likewise must not materialize an fp32 tree on DEVICE — at
+        # 1.5B a 6.2 GB fp32 detour next to the live bf16 state would
+        # OOM the 16 GB chip this mode exists for; checkpoint leaves
+        # are host numpy here, so cast leaf-wise on upload.
+        if self.bf16_sr_mode:
+            params = jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(
+                    jnp.asarray(x, self.compute_dtype), s),
+                sd["module"], self._param_shardings)
+            master = None
+        elif self.mixed_precision or self._offload_enabled():
+            params_f32 = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, jnp.float32), sd["module"])
             params = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(
                     jnp.asarray(x, self.compute_dtype), s),
                 params_f32, self._param_shardings)
-            master = None if self._offload_enabled() else \
-                jax.device_put(
-                    self.zero_policy.encode(params_f32,
-                                            self._zero_pad_plan),
-                    self._master_shardings)
+            master = jax.device_put(
+                self.zero_policy.encode(params_f32, self._zero_pad_plan),
+                self._master_shardings) if self.mixed_precision else None
         else:
+            params_f32 = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(x, jnp.float32), sd["module"])
             master = None
             params = jax.device_put(params_f32, self._param_shardings)
 

@@ -50,6 +50,10 @@ def is_pipelined_model(model):
 class PipelineEngine(DeepSpeedEngine):
     """Training engine for pipelined models (ref `pipe/engine.py:45`)."""
 
+    # Bound on distinct compiled eval-1F1B programs kept alive (one per
+    # eval batch shape); LRU beyond this.
+    _EVAL_INTERP_CACHE_MAX = 4
+
     def __init__(self, *args, **kwargs):
         model = kwargs.get("model")
         self._is_pipe_module = isinstance(model, PipelineModule)
@@ -192,10 +196,11 @@ class PipelineEngine(DeepSpeedEngine):
             # the compiled program bakes the boundary avals of the
             # first batch; silently padding a different shape would
             # corrupt the flat activation transport
-            assert self._batch_sig(stacked_batch) == self._interp_sig, \
-                ("1F1B train batches must keep one shape; got "
-                 f"{self._batch_sig(stacked_batch)} after compiling for "
-                 f"{self._interp_sig}")
+            if self._batch_sig(stacked_batch) != self._interp_sig:
+                raise ValueError(
+                    "1F1B train batches must keep one shape; got "
+                    f"{self._batch_sig(stacked_batch)} after compiling "
+                    f"for {self._interp_sig}")
             return
         self._interp_sig = self._batch_sig(stacked_batch)
         from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
@@ -221,8 +226,14 @@ class PipelineEngine(DeepSpeedEngine):
         if cache is None:
             cache = self._eval_interp_cache = {}
         if sig in cache:
-            self._eval_interp_jit = cache[sig]
+            self._eval_interp_jit = cache.pop(sig)
+            cache[sig] = self._eval_interp_jit  # LRU: re-insert as newest
             return
+        # Bounded LRU: eval loops with varying trailing partial batches
+        # would otherwise accumulate one full compiled 1F1B program per
+        # distinct shape.
+        while len(cache) >= self._EVAL_INTERP_CACHE_MAX:
+            cache.pop(next(iter(cache)))
         from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
         eval_fn = build_pipeline_step(
             module=self.module, mesh=self.mesh,

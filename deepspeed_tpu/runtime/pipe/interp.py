@@ -29,6 +29,18 @@ tick every pipe shard is executing a different microbatch.
 Tied layers (TiedLayerSpec) appear in several stages; each shard
 contributes its stage's grads and the final `psum` over the pipe axis
 IS ReduceTiedGrads (ref `module.py:405-409`).
+
+MEMORY NOTE: params enter the shard_map with spec P() — fully
+REPLICATED across pipe shards — and grads_acc is a full-model tree on
+every shard. The activation-buffer bound above is real, but pipe>1
+buys compute overlap only, NOT the per-stage parameter/gradient memory
+partitioning of the reference's multi-process pipeline. Models whose
+parameters dominate memory should combine this path with the engine's
+ZeRO sharding over the data axis (master/opt state partitioning), or
+use the homogeneous SPMD fast path in `pipe/engine.py`, which shards
+the stacked layer dim over the pipe axis. Sharding per-stage param
+subtrees over the pipe axis inside this interpreter is a known
+follow-up.
 """
 
 import functools

@@ -58,15 +58,16 @@ class ZeroOffloadMixin:
             f"ZeRO-Offload: {flat.size/1e6:.1f}M fp32 masters + moments "
             f"on host (native cpu_adam={self._host_adam.native})", ranks=[0])
 
-    # elements per transfer chunk; 4 MB of fp32 — big enough to
-    # amortize dispatch, small enough that D2H(i+1) / CPU-Adam(i) /
-    # H2D(i-1) genuinely overlap
-    _OFFLOAD_CHUNK_ELEMS = 1 << 20
-    _OFFLOAD_MAX_CHUNKS = 8
+    # Chunk size is capped in BYTES (fp32 elements x4), not in chunk
+    # count: D2H(i+1) / CPU-Adam(i) / H2D(i-1) only overlap if each
+    # chunk stays small relative to the whole model, so large models get
+    # proportionally more chunks (a fixed chunk COUNT would mean ~500 MB
+    # chunks on a 1B-param model and no real pipelining). 16 MB fp32 is
+    # big enough to amortize per-transfer dispatch.
+    _OFFLOAD_CHUNK_ELEMS = 4 << 20
 
     def _offload_bounds(self, n):
-        k = max(1, min(self._OFFLOAD_MAX_CHUNKS,
-                       n // self._OFFLOAD_CHUNK_ELEMS))
+        k = max(1, -(-n // self._OFFLOAD_CHUNK_ELEMS))
         edges = np.linspace(0, n, k + 1).astype(np.int64)
         return [(int(edges[i]), int(edges[i + 1])) for i in range(k)
                 if edges[i + 1] > edges[i]]
@@ -84,6 +85,12 @@ class ZeroOffloadMixin:
                 factor = jnp.minimum(1.0, clip / (norm + 1e-6))
                 factor = jnp.where(jnp.isfinite(factor), factor, 1.0)
                 flat = flat * factor
+            # bf16 on the wire when computing in bf16: halves D2H bytes
+            # (the reference likewise offloads fp16 grads to pinned host
+            # buffers, ref stage2.py:743-941); the host re-expands to
+            # fp32 before CPU-Adam. Unscale/clip above stay fp32.
+            if self.compute_dtype == jnp.bfloat16:
+                flat = flat.astype(jnp.bfloat16)
             return flat, norm
 
         self._offload_grad_tail_jit = jax.jit(grad_tail)
@@ -136,7 +143,10 @@ class ZeroOffloadMixin:
         self._host_adam.begin_step()
         out_chunks = []
         for (lo, hi), c in zip(bounds, grad_chunks):
-            g_np = np.asarray(c, dtype=np.float32)
+            # fetch in the wire dtype (bf16 when computing bf16), THEN
+            # widen on host — np.asarray(c, dtype=f32) could upcast
+            # device-side and transfer twice the bytes
+            g_np = np.asarray(c).astype(np.float32, copy=False)
             if self.compute_dtype == jnp.bfloat16:
                 # fused native chunk step + bf16 downcast in one pass
                 bf16_out = np.empty(hi - lo, np.uint16)

@@ -134,34 +134,48 @@ class ThroughputTimer:
     def start(self):
         self._init_timer()
         self.started = True
-        if self.global_step_count >= self.start_step:
-            _device_sync()
-            self.start_time = time.time()
 
     def stop(self, report_speed=True, count=1):
         """`count` = microbatches consumed since start() (a fused
-        grad-accum step consumes several at once)."""
+        grad-accum step consumes several at once).
+
+        Device fences happen ONLY at measurement-window boundaries (end
+        of warmup, and each steps_per_output report) — a per-step
+        `effects_barrier` would serialize host and device every step,
+        which on a remote-dispatch TPU runtime costs more than the step
+        itself. Between fences the device queue stays full; the
+        window's wall time divided by its step count is exact."""
         if not self.started:
             return
         self.started = False
         self.micro_step_count += count
         self.global_step_count += count
-        if self.start_time > 0:
+        if self.start_time == 0:
+            if self.global_step_count >= self.start_step:
+                # warmup done: fence once and open the window
+                _device_sync()
+                self.start_time = time.time()
+                self._steps_at_window_start = self.global_step_count
+            return
+        if report_speed and \
+                self.global_step_count % self.steps_per_output < count:
             _device_sync()
             self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            if report_speed and \
-                    self.global_step_count % self.steps_per_output < count:
-                self.logging(
-                    "{}/{}, SamplesPerSec={}".format(
-                        self.epoch_count, self.micro_step_count,
-                        self.avg_samples_per_sec()))
+            self.total_elapsed_time = self.end_time - self.start_time
+            self.logging(
+                "{}/{}, SamplesPerSec={}".format(
+                    self.epoch_count, self.micro_step_count,
+                    self.avg_samples_per_sec()))
+            # restart the window so a host-side pause (checkpoint save,
+            # eval loop) dilutes at most ONE report, not all of them
+            self.start_time = self.end_time
+            self._steps_at_window_start = self.global_step_count
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > self.start_step:
+        base = getattr(self, "_steps_at_window_start", self.start_step)
+        if self.global_step_count > base and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.global_step_count - self.start_step
+            total_step_offset = self.global_step_count - base
             avg_time_per_step = self.total_elapsed_time / total_step_offset
             return samples_per_step / avg_time_per_step
         return float("-inf")

@@ -157,7 +157,6 @@ def test_offload_multi_chunk_pipeline_matches_device(monkeypatch):
     engine (the overlap must be a pure scheduling change)."""
     from deepspeed_tpu.runtime.zero.offload import ZeroOffloadMixin
     monkeypatch.setattr(ZeroOffloadMixin, "_OFFLOAD_CHUNK_ELEMS", 1024)
-    monkeypatch.setattr(ZeroOffloadMixin, "_OFFLOAD_MAX_CHUNKS", 8)
     e_dev, ids = _gpt2_engine(offload=False)
     e_off, _ = _gpt2_engine(offload=True)
     assert len(e_off._offload_bounds(

@@ -1,0 +1,139 @@
+"""Standalone block-sparse MatMul/Softmax primitives vs dense reference
+(parity target: ref `tests/unit/test_sparse_attention.py:163-239` —
+sdd/dsd/dds x trans_a x trans_b sweep, softmax with masks, and the
+end-to-end sdd->softmax->dsd attention composition)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (MatMul, Softmax,
+                                                to_sparse, to_dense)
+
+B, H, BLOCK = 2, 3, 16
+R = C = 4   # block grid
+M = R * BLOCK
+K = 24
+
+
+def _layout(seed=0, density=0.5):
+    rng = np.random.RandomState(seed)
+    lay = (rng.rand(H, R, C) < density).astype(np.int64)
+    lay[:, 0, 0] = 1   # no empty layout
+    return lay
+
+
+def _dense_mask(lay):
+    return np.kron(lay, np.ones((BLOCK, BLOCK)))  # [H, M, M]
+
+
+@pytest.mark.parametrize("trans_a", [False, True])
+@pytest.mark.parametrize("trans_b", [False, True])
+def test_sdd_matches_dense(trans_a, trans_b):
+    lay = _layout()
+    rng = np.random.RandomState(1)
+    a = jnp.asarray(rng.randn(B, H, *((K, M) if trans_a else (M, K))),
+                    jnp.float32)
+    b = jnp.asarray(rng.randn(B, H, *((M, K) if trans_b else (K, M))),
+                    jnp.float32)
+    out = MatMul(lay, BLOCK, "sdd", trans_a, trans_b)(a, b)
+    ad = np.swapaxes(a, -1, -2) if trans_a else np.asarray(a)
+    bd = np.swapaxes(b, -1, -2) if trans_b else np.asarray(b)
+    ref = np.einsum("bhmk,bhkn->bhmn", ad, bd) * _dense_mask(lay)[None]
+    got = to_dense(out, lay, BLOCK)
+    np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("trans_a", [False, True])
+def test_dsd_matches_dense(trans_a):
+    lay = _layout(2)
+    rng = np.random.RandomState(3)
+    a_dense = rng.randn(B, H, M, M) * _dense_mask(lay)[None]
+    a_sparse = to_sparse(jnp.asarray(a_dense, jnp.float32), lay, BLOCK)
+    b = jnp.asarray(rng.randn(B, H, M, K), jnp.float32)
+    out = MatMul(lay, BLOCK, "dsd", trans_a=trans_a)(a_sparse, b)
+    ad = np.swapaxes(a_dense, -1, -2) if trans_a else a_dense
+    ref = np.einsum("bhmn,bhnk->bhmk", ad, np.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("trans_b", [False, True])
+def test_dds_matches_dense(trans_b):
+    lay = _layout(4)
+    rng = np.random.RandomState(5)
+    b_dense = rng.randn(B, H, M, M) * _dense_mask(lay)[None]
+    b_sparse = to_sparse(jnp.asarray(b_dense, jnp.float32), lay, BLOCK)
+    a = jnp.asarray(rng.randn(B, H, K, M), jnp.float32)
+    out = MatMul(lay, BLOCK, "dds", trans_b=trans_b)(a, b_sparse)
+    bd = np.swapaxes(b_dense, -1, -2) if trans_b else b_dense
+    ref = np.einsum("bhkm,bhmn->bhkn", np.asarray(a), bd)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-4)
+
+
+def test_softmax_matches_dense_with_masks():
+    lay = _layout(6)
+    rng = np.random.RandomState(7)
+    scores = rng.randn(B, H, M, M).astype(np.float32)
+    sp = to_sparse(jnp.asarray(scores), lay, BLOCK)
+    kpm = np.where(rng.rand(B, M) < 0.2, -1e30, 0.0).astype(np.float32)
+    am = np.where(rng.rand(M, M) < 0.1, -1e30, 0.0).astype(np.float32)
+    out = Softmax(lay, BLOCK)(sp, scale=0.5, key_padding_mask=jnp.asarray(kpm),
+                              attn_mask=jnp.asarray(am))
+    mask = _dense_mask(lay)[None]
+    dense = scores * 0.5 + kpm[:, None, None, :] + am[None, None]
+    dense = np.where(mask > 0, dense, -np.inf)
+    e = np.exp(dense - dense.max(-1, keepdims=True))
+    e = np.where(np.isfinite(dense), e, 0.0)
+    ref = e / np.maximum(e.sum(-1, keepdims=True), 1e-30)
+    got = np.asarray(to_dense(out, lay, BLOCK))
+    np.testing.assert_allclose(got * mask, ref * mask, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_softmax_mul_mode_and_empty_rows():
+    lay = _layout(8)
+    rng = np.random.RandomState(9)
+    sp = to_sparse(jnp.asarray(rng.randn(B, H, M, M), jnp.float32),
+                   lay, BLOCK)
+    kpm = np.zeros((B, M), np.float32)   # mul-mode: 0 masks EVERYTHING
+    out = Softmax(lay, BLOCK)(sp, key_padding_mask=jnp.asarray(kpm),
+                              key_padding_mask_mode="mul")
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_attention_composition_grads():
+    """sdd -> softmax -> dsd equals dense attention, and grads flow."""
+    lay = _layout(10, density=0.6)
+    d = 32
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(B, H, M, d), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, M, d), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, M, d), jnp.float32)
+    sdd = MatMul(lay, BLOCK, "sdd", trans_b=True)
+    sm = Softmax(lay, BLOCK)
+    dsd = MatMul(lay, BLOCK, "dsd")
+
+    def attn(q, k, v):
+        return dsd(sm(sdd(q, k), scale=d ** -0.5), v)
+
+    out = attn(q, k, v)
+    mask = _dense_mask(lay)[None]
+    s = np.einsum("bhmd,bhnd->bhmn", q, k) * d ** -0.5
+    s = np.where(mask > 0, s, -np.inf)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhmn,bhnd->bhmd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-4)
+
+    g = jax.grad(lambda q: attn(q, k, v).sum())(q)
+    assert np.isfinite(np.asarray(g)).all()
+    gref = jax.grad(lambda q: jnp.sum(
+        jnp.einsum("bhmn,bhnd->bhmd",
+                   jax.nn.softmax(jnp.where(
+                       jnp.asarray(mask) > 0,
+                       jnp.einsum("bhmd,bhnd->bhmn", q, k) * d ** -0.5,
+                       -jnp.inf), axis=-1), v)))(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gref),
+                               rtol=1e-4, atol=1e-4)
