@@ -8,6 +8,15 @@ Public API parity with `deepspeed/__init__.py`:
 """
 
 import argparse
+import os
+
+# Platform override hook: DS_TPU_PLATFORM=cpu forces the JAX backend
+# before any device use — needed by subprocess harnesses (tests/model/,
+# launcher smoke) on machines whose sitecustomize pins a TPU plugin
+# (plain JAX_PLATFORMS env is applied before the pin and loses).
+if os.environ.get("DS_TPU_PLATFORM"):
+    import jax as _jax
+    _jax.config.update("jax_platforms", os.environ["DS_TPU_PLATFORM"])
 
 from deepspeed_tpu.version import __version__
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine

@@ -42,6 +42,11 @@ class BertConfig:
 
 
 BERT_SIZES = {
+    # CI/harness size: big enough to have real trajectories, small
+    # enough for the virtual CPU mesh (tests/model/)
+    "bert-tiny": dict(hidden_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=512,
+                      vocab_size=512),
     "bert-base": dict(hidden_size=768, num_hidden_layers=12,
                       num_attention_heads=12, intermediate_size=3072),
     "bert-large": dict(hidden_size=1024, num_hidden_layers=24,

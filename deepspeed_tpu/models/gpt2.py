@@ -69,6 +69,9 @@ class GPT2Config:
 # Named model sizes (GPT-2 paper + GPT-3-style scale points used by the
 # reference's Megatron benchmarks).
 GPT2_SIZES = {
+    # CI/harness size (tests/model/): real trajectories on a CPU mesh
+    "gpt2-tiny": dict(n_layer=2, n_embd=64, n_head=4, vocab_size=512,
+                      n_positions=128),
     "gpt2-125m": dict(n_layer=12, n_embd=768, n_head=12),
     "gpt2-350m": dict(n_layer=24, n_embd=1024, n_head=16),
     "gpt2-760m": dict(n_layer=24, n_embd=1536, n_head=16),

@@ -27,19 +27,33 @@ from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
 def get_args():
     parser = argparse.ArgumentParser(description="GPT-2 pretraining")
     parser.add_argument("--model", default="gpt2-125m",
-                        help="gpt2-125m .. gpt2-13b")
+                        help="gpt2-tiny .. gpt2-13b")
     parser.add_argument("--seq-len", type=int, default=1024)
     parser.add_argument("--steps", type=int, default=50)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--save-dir", default=None,
+                        help="checkpoint dir (omit to skip saving)")
+    parser.add_argument("--num-batches", type=int, default=0,
+                        help="cycle a FIXED set of N synthetic batches "
+                             "(learnable; the model harness uses this) "
+                             "instead of an endless random stream")
     parser = deepspeed_tpu.add_config_arguments(parser)
     return parser.parse_args()
 
 
-def synthetic_batches(vocab, micro_bs, gas, seq, seed):
+def synthetic_batches(vocab, micro_bs, gas, seq, seed, num_batches=0):
     rng = np.random.default_rng(seed)
+    fixed = [{"input_ids": rng.integers(
+        0, vocab, (gas, micro_bs, seq)).astype(np.int32)}
+        for _ in range(num_batches)] if num_batches else None
+    i = 0
     while True:
-        yield {"input_ids": rng.integers(
-            0, vocab, (gas, micro_bs, seq)).astype(np.int32)}
+        if fixed is not None:
+            yield fixed[i % len(fixed)]
+            i += 1
+        else:
+            yield {"input_ids": rng.integers(
+                0, vocab, (gas, micro_bs, seq)).astype(np.int32)}
 
 
 def main():
@@ -57,14 +71,22 @@ def main():
     micro = engine.train_micro_batch_size_per_gpu()
     gas = engine.gradient_accumulation_steps()
     data = synthetic_batches(cfg.vocab_size, micro, gas, args.seq_len,
-                             args.seed)
+                             args.seed, args.num_batches)
+    losses = []
     for step in range(args.steps):
         loss = engine.train_batch(batch=next(data))
+        losses.append(loss)    # fetched after the loop — no per-step sync
         if step % engine.steps_per_print() == 0:
             deepspeed_tpu.log_dist(
                 f"step {step}: loss {float(jax.device_get(loss)):.4f}",
                 ranks=[0])
-    engine.save_checkpoint("checkpoints/gpt2")
+    # full trajectory in one greppable line (the model-level regression
+    # harness parses this; ref run_func_test.py greps "LM loss:")
+    traj = [round(float(jax.device_get(l)), 6) for l in losses]
+    print("LM loss trajectory:", " ".join(f"{x:.6f}" for x in traj),
+          flush=True)
+    if args.save_dir:
+        engine.save_checkpoint(args.save_dir)
 
 
 if __name__ == "__main__":

@@ -21,7 +21,63 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 assert len(jax.devices()) == 8, jax.devices()
 
+# Persistent compilation cache: most of the suite's wall time is XLA
+# compiles of the same tiny-model programs; caching them makes reruns
+# minutes faster (first run pays full price and fills the cache).
+_cache_dir = os.environ.get("JAX_TEST_COMPILATION_CACHE",
+                            os.path.join(os.path.dirname(__file__),
+                                         "..", ".jax_test_cache"))
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.abspath(_cache_dir))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (deselect with -m 'not slow' "
+                   "for the <3 min fast tier)")
+
+
+# Heaviest tests by measured duration (cold-cache full-suite run); the
+# fast tier is `pytest -m "not slow"`. Subprocess-based suites
+# (tests/model/, launcher e2e, parity sweep) mark themselves.
+_SLOW_TESTS = {
+    "test_gpt2_trains_with_sequence_parallel_config",
+    "test_pipeline_engine_matches_dense_engine_losses",
+    "test_offload_engine_matches_device_engine",
+    "test_gpt2_tiny_trains",
+    "test_gpt2_ring_sequence_parallel_matches",
+    "test_elastic_reload_different_mesh",
+    "test_ring_attention_grads_match_dense",
+    "test_pipeline_engine_trains_3d",
+    "test_engine_sr_mode_loss_descends",
+    "test_save_writes_shard_files_no_pickle",
+    "test_engine_profile_step_runs",
+    "test_bert_pretraining_trains",
+    "test_pld_theta_schedule_and_training",
+    "test_sr_trajectory_matches_fp32_master",
+    "test_1f1b_matches_sequential_chain",
+    "test_offload_checkpoint_roundtrip",
+    "test_1f1b_bf16_transport_matches_sequential",
+    "test_sparse_path_update_matches_dense",
+    "test_1f1b_with_zero2_padding",
+    "test_offload_multi_chunk_pipeline_matches_device",
+    "test_1f1b_tied_layers_sum_grads",
+    "test_grads_match_dense",
+    "test_tied_layer_spec_shares_weights",
+    "test_csr_mean_rows_matches_pmean",
+    "test_ulysses_grads_match_dense",
+    "test_pipeline_loss_matches_sequential",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        base = item.name.split("[")[0]
+        if base in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
 
 
 @pytest.fixture

@@ -1,0 +1,146 @@
+"""Launcher end-to-end smoke (parity target: ref
+`tests/unit/common.py:16-104`, which actually forks distributed
+workers): `dstpu` really spawns a training child, and the per-node
+launcher really stands up a 2-process `jax.distributed` rendezvous on
+the CPU backend with rank env + cross-rank loss agreement.
+
+These spawn subprocesses and pay JAX startup each time -> slow tier.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_TRAIN_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, __REPO__)
+    import deepspeed_tpu           # applies DS_TPU_PLATFORM before jax use
+    import jax, numpy as np
+
+    dist = os.environ.get("WORLD_SIZE") is not None
+    if dist:
+        deepspeed_tpu.init_distributed()
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(4)(nn.tanh(nn.Dense(16)(x)))
+
+    class Model:
+        def __init__(self):
+            self.net = Net()
+            x = np.zeros((4, 8), np.float32)
+            self.params = self.net.init(jax.random.PRNGKey(0), x)["params"]
+        def loss_fn(self, params, batch, rngs=None, deterministic=False):
+            y = self.net.apply({"params": params}, batch["x"])
+            return jnp.mean((y - batch["y"]) ** 2)
+
+    m = Model()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=m.params,
+        config={"train_micro_batch_size_per_gpu":
+                    8 // max(1, jax.device_count()),
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 5e-2}}})
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 8, 8).astype(np.float32)
+    w = np.linspace(-1, 1, 32).reshape(8, 4).astype(np.float32)
+    batch = {"x": x, "y": x @ w}
+    for i in range(10):
+        loss = engine.train_batch(batch=batch)
+    print("SMOKE_RESULT:" + json.dumps({
+        "rank": os.environ.get("RANK"),
+        "world": os.environ.get("WORLD_SIZE"),
+        "n_devices": jax.device_count(),
+        "loss": round(float(jax.device_get(loss)), 6)}), flush=True)
+""")
+
+
+def _write_script(tmp_path):
+    p = tmp_path / "smoke_train.py"
+    p.write_text(_TRAIN_SCRIPT.replace("__REPO__", repr(REPO)))
+    return str(p)
+
+
+def _base_env():
+    env = dict(os.environ)
+    env["DS_TPU_PLATFORM"] = "cpu"
+    env.pop("XLA_FLAGS", None)   # 1 real CPU device per process
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   env.get("JAX_TEST_COMPILATION_CACHE",
+                           os.path.join(REPO, ".jax_test_cache")))
+    return env
+
+
+def _parse(stdout):
+    import json
+    for line in stdout.splitlines():
+        if line.startswith("SMOKE_RESULT:"):
+            return json.loads(line[len("SMOKE_RESULT:"):])
+    return None
+
+
+@pytest.mark.slow
+def test_dstpu_spawns_single_node_training(tmp_path):
+    """`bin/dstpu script.py` must actually spawn and run the child."""
+    script = _write_script(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dstpu"), script],
+        capture_output=True, text=True, timeout=600, env=_base_env(),
+        cwd=REPO)
+    res = _parse(proc.stdout)
+    assert proc.returncode == 0 and res, \
+        (proc.returncode, proc.stdout[-800:], proc.stderr[-800:])
+    assert res["loss"] < 0.5, res
+
+
+@pytest.mark.slow
+def test_launch_two_process_jax_distributed(tmp_path):
+    """Two per-node launcher processes rendezvous via jax.distributed
+    (CPU backend): both ranks see the 2-device global mesh, train the
+    same 10 steps, and report identical losses."""
+    from deepspeed_tpu.launcher.runner import encode_world_info
+    script = _write_script(tmp_path)
+    world = encode_world_info({"nodeA": [0], "nodeB": [0]})
+    # free port (a hardcoded one collides across concurrent runs)
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = _base_env()
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             "--world_info", world, "--node_rank", str(rank),
+             "--master_addr", "127.0.0.1", "--master_port", str(port),
+             script],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    results = [_parse(o[1]) for o in outs]
+    assert all(o[0] == 0 for o in outs) and all(results), \
+        [(o[0], o[1][-400:], o[2][-600:]) for o in outs]
+    ranks = sorted(r["rank"] for r in results)
+    assert ranks == ["0", "1"], results
+    assert all(r["world"] == "2" for r in results), results
+    assert all(r["n_devices"] == 2 for r in results), results
+    # same global data + same program -> identical loss on every rank
+    assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6, results
+    assert results[0]["loss"] < 0.5, results
