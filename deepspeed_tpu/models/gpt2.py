@@ -141,16 +141,28 @@ def _attention(config, q, k, v, dropout_rng, deterministic):
     if config.attention_impl in ("pallas", "auto"):
         try:
             from deepspeed_tpu.ops.transformer.flash_attention import (
-                flash_attention_usable, flash_attention)
+                flash_attention_usable, flash_attention,
+                flash_attention_rematerializable)
             if flash_attention_usable(q, deterministic or config.dropout == 0.0):
+                if config.remat:
+                    # (out, lse) carry checkpoint_names: with a
+                    # save_only_these_names:attn_out,attn_lse policy the
+                    # backward never re-runs the flash fwd kernel
+                    return flash_attention_rematerializable(
+                        q, k, v, causal=True)
                 return flash_attention(q, k, v, causal=True)
         except ImportError:
             pass
         if config.attention_impl == "pallas":
             raise RuntimeError("pallas attention requested but unusable "
                                "for these shapes/settings")
-    return causal_attention_xla(q, k, v, dropout_rng, config.dropout,
-                                deterministic)
+    out = causal_attention_xla(q, k, v, dropout_rng, config.dropout,
+                               deterministic)
+    # keep the named residual on the XLA path too, so
+    # save_only_these_names:attn_out policies behave uniformly (no lse
+    # here — XLA attention has no separate softmax stats to save)
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(out, "attn_out")
 
 
 class GPT2Block(nn.Module):
@@ -177,15 +189,13 @@ class GPT2Block(nn.Module):
         drop_rng = None
         if not deterministic and cfg.dropout > 0.0:
             drop_rng = self.make_rng("dropout")
+        # Under remat, the pallas path names its (out, lse) residuals
+        # "attn_out"/"attn_lse" (flash_attention_rematerializable): a
+        # "save_only_these_names:attn_out,attn_lse" policy then saves
+        # ~27 MB/layer at 1.5B and the backward pass never re-runs the
+        # flash forward kernel — the sweet spot between full remat
+        # (+1 fwd of recompute) and dots_saveable (~235 MB/layer, OOM).
         attn = _attention(cfg, q, k, v, drop_rng, deterministic)
-        # Named checkpoint: lets a "save_only_these_names:attn_out"
-        # remat policy save ONLY the attention output (26 MB/layer at
-        # 1.5B scale) so the backward pass never re-runs the flash
-        # kernel while everything else (ln, qkv, mlp) is still
-        # recomputed — the sweet spot between full remat (+1 fwd of
-        # recompute) and dots_saveable (~235 MB/layer, OOM at 1.5B).
-        from jax.ad_checkpoint import checkpoint_name
-        attn = checkpoint_name(attn, "attn_out")
         attn = attn.reshape(b, t, cfg.n_embd)
         # proj init scaled down by depth (GPT-2 residual-scaling trick)
         attn = _dense(cfg.n_embd, cfg, "c_proj",

@@ -31,6 +31,23 @@ from jax.experimental.pallas import tpu as pltpu
 from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF, _on_tpu,
                                                            dense_attention)
 
+# f32 score-tile budget per grid step and the matching Mosaic
+# scoped-vmem ceiling (default 16 MB refuses ~18 MB stacks; the chip
+# has 128 MB of VMEM)
+_SCORE_TILE_BUDGET = 4 * 1024 * 1024
+_VMEM_LIMIT = 64 * 1024 * 1024
+_FWD_MIN_OUTER = 8
+
+
+def _compiler_params(kind):
+    # Measured on v5e at the 16k bench point: the BACKWARD kernels want
+    # ("parallel","parallel","arbitrary") (+40% over default), while
+    # the forward's online-softmax carry pipelines better with Mosaic's
+    # own scheduling (declared semantics cost it ~25%).
+    sem = ("parallel", "parallel", "arbitrary") if kind == "bwd" else None
+    return pltpu.CompilerParams(
+        dimension_semantics=sem, vmem_limit_bytes=_VMEM_LIMIT)
+
 
 # ----------------------------------------------------------------------
 # layout -> visible-block index tables
@@ -128,7 +145,8 @@ def _visible_mask(mbits, R, ki, qt, block, causal):
 
 def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref, k_ref,
                    v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                   sm_scale, causal, block, num_heads, nqs, kmax, g, qt):
+                   sm_scale, causal, block, num_heads, nqs, kmax, g, qt,
+                   lse2d):
     # blocks carry G heads x QT layout rows per grid step (legal because
     # grouped heads share one layout row): fewer, fatter steps amortize
     # the per-step grid/DMA overhead that starves small tiles; the
@@ -183,15 +201,21 @@ def _bs_fwd_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref, k_ref,
         # export lse=+inf, not NEG_INF+log(1e-30): the backward kernels
         # compute p=exp(s-lse) and only +inf sends every masked score to
         # exactly 0 (delta=0 does not cancel the dp term).
-        lse_ref[...] = jnp.where(l > 0.0,
-                                 m_scr[:, :, :1] + jnp.log(l_safe),
-                                 jnp.inf)
+        # lse rides [g, qtb] when the head group allows it — t in the
+        # MINOR dim (a [.., t, 1] layout pads the 1-wide minor to full
+        # 128-lane tiles: 128x the write bytes)
+        lse_val = jnp.where(l > 0.0, m_scr[:, :, :1] + jnp.log(l_safe),
+                            jnp.inf)
+        if lse2d:
+            lse_ref[...] = lse_val[:, :, 0]
+        else:
+            lse_ref[...] = lse_val
 
 
 def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, qmask_ref, q_ref,
                        k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
                        dv_ref, dk_scr, dv_scr, *, sm_scale, causal,
-                       block, num_heads, nqs, qmax, g, qt):
+                       block, num_heads, nqs, qmax, g, qt, lse2d):
     ki = pl.program_id(1)
     st = pl.program_id(2)
     # the q-side tables for dK/dV are indexed by KEY column: nk == nq
@@ -212,8 +236,8 @@ def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, qmask_ref, q_ref,
         k = k_ref[...]
         v = v_ref[...]
         do = do_ref[...]
-        lse = lse_ref[...]
-        delta = delta_ref[...]
+        lse = lse_ref[...][..., None] if lse2d else lse_ref[...]
+        delta = delta_ref[...][..., None] if lse2d else delta_ref[...]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale  # [G,QTB,B]
@@ -241,7 +265,7 @@ def _bs_bwd_dkv_kernel(hm_ref, qidx_ref, qcnt_ref, qmask_ref, q_ref,
 def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref,
                       k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                       dq_scr, *, sm_scale, causal, block, num_heads,
-                      nqs, kmax, g, qt):
+                      nqs, kmax, g, qt, lse2d):
     R = pl.program_id(1)
     st = pl.program_id(2)
     row = _row(hm_ref, pl.program_id(0) * g, R, nqs, num_heads)
@@ -259,8 +283,8 @@ def _bs_bwd_dq_kernel(hm_ref, kidx_ref, kcnt_ref, kmask_ref, q_ref,
         k = k_ref[...]
         v = v_ref[...]
         do = do_ref[...]
-        lse = lse_ref[...]
-        delta = delta_ref[...]
+        lse = lse_ref[...][..., None] if lse2d else lse_ref[...]
+        delta = delta_ref[...][..., None] if lse2d else delta_ref[...]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
@@ -309,10 +333,13 @@ def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
     def to_bht(x):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
 
+    lse2d = (g % 8 == 0)   # 2-D lse blocks need sublane-divisible g
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block=block, num_heads=h,
-                               nqs=nqs, kmax=kmax, g=g, qt=qt)
+                               nqs=nqs, kmax=kmax, g=g, qt=qt,
+                               lse2d=lse2d)
     fixed = lambda grp, R, st, *_: (grp, R, 0)
+    fixed2 = lambda grp, R, st, *_: (grp, R)
     kv = _k_lookup(nqs, kmax, h, g)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
@@ -324,6 +351,7 @@ def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
         ],
         out_specs=[
             pl.BlockSpec((g, qtb, d), fixed),
+            pl.BlockSpec((g, qtb), fixed2) if lse2d else
             pl.BlockSpec((g, qtb, 1), fixed),
         ],
         scratch_shapes=[
@@ -335,9 +363,11 @@ def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
+        compiler_params=_compiler_params("fwd"),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t) if lse2d else (bh, t, 1),
+                                 jnp.float32),
         ],
         interpret=interpret,
     )(head_map, kidx, kcnt, kmask, to_bht(q), to_bht(k), to_bht(v))
@@ -362,15 +392,17 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
 
     qt_, kt, vt, dot_ = to_bht(q), to_bht(k), to_bht(v), to_bht(g)
     ot = to_bht(out)
+    lse2d = (lse.ndim == 2)
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
-                    axis=-1, keepdims=True)
+                    axis=-1, keepdims=not lse2d)
 
     fixed1 = lambda grp, ki, st, *_: (grp, ki, 0)
     qv = _q_lookup(nk, qmax, h, g_grp)
+    qv2 = lambda grp, ki, st, *refs: qv(grp, ki, st, *refs)[:2]
     dkv_kernel = functools.partial(_bs_bwd_dkv_kernel, sm_scale=sm_scale,
                                    causal=causal, block=block,
                                    num_heads=h, nqs=nqs, qmax=qmax,
-                                   g=g_grp, qt=qt)
+                                   g=g_grp, qt=qt, lse2d=lse2d)
     dkv_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(bh // g_grp, nk, qmax),
@@ -379,8 +411,10 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
             pl.BlockSpec((g_grp, block, d), fixed1),  # k at ki
             pl.BlockSpec((g_grp, block, d), fixed1),  # v at ki
             pl.BlockSpec((g_grp, qtb, d), qv),      # do super-row
-            pl.BlockSpec((g_grp, qtb, 1), qv),      # lse super-row
-            pl.BlockSpec((g_grp, qtb, 1), qv),      # delta super-row
+            (pl.BlockSpec((g_grp, qtb), qv2) if lse2d else
+             pl.BlockSpec((g_grp, qtb, 1), qv)),    # lse super-row
+            (pl.BlockSpec((g_grp, qtb), qv2) if lse2d else
+             pl.BlockSpec((g_grp, qtb, 1), qv)),    # delta super-row
         ],
         out_specs=[
             pl.BlockSpec((g_grp, block, d), fixed1),
@@ -394,6 +428,7 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid_spec=dkv_spec,
+        compiler_params=_compiler_params("bwd"),
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, d), k.dtype),
             jax.ShapeDtypeStruct((bh, t, d), v.dtype),
@@ -406,7 +441,7 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
     dq_kernel = functools.partial(_bs_bwd_dq_kernel, sm_scale=sm_scale,
                                   causal=causal, block=block,
                                   num_heads=h, nqs=nqs, kmax=kmax,
-                                  g=g_grp, qt=qt)
+                                  g=g_grp, qt=qt, lse2d=lse2d)
     dq_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(bh // g_grp, nqs, kmax),
@@ -415,8 +450,10 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
             pl.BlockSpec((g_grp, block, d), kv),
             pl.BlockSpec((g_grp, block, d), kv),
             pl.BlockSpec((g_grp, qtb, d), fixed),
-            pl.BlockSpec((g_grp, qtb, 1), fixed),
-            pl.BlockSpec((g_grp, qtb, 1), fixed),
+            (pl.BlockSpec((g_grp, qtb), lambda grp, R, st, *_: (grp, R))
+             if lse2d else pl.BlockSpec((g_grp, qtb, 1), fixed)),
+            (pl.BlockSpec((g_grp, qtb), lambda grp, R, st, *_: (grp, R))
+             if lse2d else pl.BlockSpec((g_grp, qtb, 1), fixed)),
         ],
         out_specs=pl.BlockSpec((g_grp, qtb, d), fixed),
         scratch_shapes=[pltpu.VMEM((g_grp, qtb, d), jnp.float32)],
@@ -424,6 +461,7 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
     dq = pl.pallas_call(
         dq_kernel,
         grid_spec=dq_spec,
+        compiler_params=_compiler_params("bwd"),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
     )(head_map, kidx, kcnt, kmask, qt_, kt, vt, dot_, lse, delta)
@@ -432,21 +470,254 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
             None, None, None, None, None, None, None)
 
 
+# ----------------------------------------------------------------------
+# band + global fast path (Longformer/Fixed-class layouts)
+# ----------------------------------------------------------------------
+def _band_decompose(layout, causal, max_globals=64):
+    """Causal-folded layout -> (w, global_cols) when it is EXACTLY a
+    width-w sliding block window plus a set of globally-visible block
+    columns; None otherwise (BigBird random blocks, per-head layouts).
+
+    The shipped Fixed and BSLongformer patterns decompose; the fast
+    forward then replaces the per-visible-block table walk with ONE
+    contiguous band fetch + regular tiles over the gathered global
+    columns — far fewer, far fatter grid steps."""
+    lay = np.asarray(layout, np.int32)
+    if lay.ndim == 3:
+        if not (lay == lay[:1]).all():
+            return None            # per-head layouts: table path
+        lay = lay[0]
+    vis = lay != 0
+    nq = vis.shape[0]
+    if causal:
+        vis = vis & np.tril(np.ones_like(vis, dtype=bool))
+    rows_i, cols_j = np.nonzero(vis)
+    # global columns: visible from EVERY (causal-)eligible row
+    gcols = []
+    for j in range(nq):
+        rows_seeing = vis[:, j]
+        expect = np.arange(nq) >= j if causal else np.ones(nq, bool)
+        if (rows_seeing == expect).all():
+            gcols.append(j)
+    gset = set(gcols)
+    off_band = [(i, j) for i, j in zip(rows_i, cols_j) if j not in gset]
+    w = max((i - j + 1 for i, j in off_band), default=1)
+    if len(gcols) > max_globals:
+        return None
+    # exact reconstruction check (the fast path must not attend extra
+    # entries nor drop any)
+    ii = np.arange(nq)[:, None]
+    jj = np.arange(nq)[None, :]
+    band = (jj <= ii) & (jj >= ii - w + 1) if causal else \
+        (np.abs(ii - jj) < w)
+    expected = band.copy()
+    for j in gcols:
+        expected[:, j] |= (np.arange(nq) >= j) if causal else True
+    if causal:
+        expected &= np.tril(np.ones_like(expected, dtype=bool))
+    if not np.array_equal(vis, expected):
+        return None
+    return int(w), tuple(int(j) for j in gcols)
+
+
+def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
+                     o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
+                     block, qt, w, n_steps, tk, g, lse2d, causal, nq,
+                     BW):
+    R = pl.program_id(1)
+    st = pl.program_id(2)
+    qtb = qt * block
+
+    def online_update(s, vv):
+        m_prev = m_scr[:, :, :1]
+        l_prev = l_scr[:, :, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        m_safe = jnp.maximum(m_new, NEG_INF / 2)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.minimum(m_prev - m_safe, 0.0))
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vv.dtype), vv, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:, :, :1] = m_new
+        l_scr[:, :, :1] = l_new
+
+    @pl.when(st == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        s = jax.lax.dot_general(
+            q_ref[...], kb_ref[...], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale
+        # band start (block units) — must mirror the index map exactly
+        S = jnp.clip(R * qt - (w - 1), 0, nq - BW)
+        rows = jax.lax.broadcasted_iota(jnp.int32, (qtb, BW * block), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (qtb, BW * block), 1)
+        gp = R * qtb + rows
+        kp = S * block + cols
+        visible = (kp // block) >= (gp // block - (w - 1))
+        if causal:
+            visible = visible & (kp <= gp)
+        else:
+            visible = visible & ((kp // block) <= (gp // block + (w - 1)))
+        s = jnp.where(visible[None], s, NEG_INF)
+        online_update(s, vb_ref[...])
+
+    @pl.when(st > 0)
+    def _():
+        s = jax.lax.dot_general(
+            q_ref[...], kg_ref[...], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale
+        pos = pos_ref[0, :]                       # [tk] source positions
+        rows = jax.lax.broadcasted_iota(jnp.int32, (qtb, tk), 0)
+        gp = R * qtb + rows
+        # exclude entries the band step already covered (double count)
+        # and the zero-K padding tail (pos is 2**30 there — without the
+        # bound it would pass the non-causal test and add phantom mass)
+        valid = pos[None, :] < nq * block
+        if causal:
+            visible = ((pos[None, :] // block) < (gp // block - (w - 1))) \
+                & (pos[None, :] <= gp) & valid
+        else:
+            diff = pos[None, :] // block - gp // block
+            visible = ((diff < -(w - 1)) | (diff > (w - 1))) & valid
+        s = jnp.where(visible[None], s, NEG_INF)
+        online_update(s, vg_ref[...])
+
+    @pl.when(st == n_steps - 1)
+    def _():
+        l = l_scr[:, :, :1]
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[...] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_val = jnp.where(l > 0.0, m_scr[:, :, :1] + jnp.log(l_safe),
+                            jnp.inf)
+        if lse2d:
+            lse_ref[...] = lse_val[:, :, 0]
+        else:
+            lse_ref[...] = lse_val
+
+
+def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt):
+    """(out [bh,t,d], lse) via the band+global forward."""
+    w, gcols = band
+    b, t, h, d = q.shape
+    bh = b * h
+    nq = t // block
+    nqs = nq // qt
+    qtb = qt * block
+    BW = min(nq, (w + qt - 1) if causal else (2 * w + qt - 2))
+
+    def to_bht(x):
+        return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
+
+    qb, kb, vb = to_bht(q), to_bht(k), to_bht(v)
+
+    # gathered global columns (+1 tile of padding when empty); positions
+    # beyond t mask to invisible
+    tk = min(1024, max(block, 512))
+    if gcols:
+        gidx = np.concatenate(
+            [np.arange(block) + j * block for j in gcols])
+        pos = gidx.astype(np.int32)
+    else:
+        gidx = np.zeros((0,), np.int64)
+        pos = np.zeros((0,), np.int32)
+    ng = len(gidx)
+    pad = (-ng) % tk if ng else tk
+    n_steps = 1 + (ng + pad) // tk if ng else 1
+    kg = jnp.pad(kb[:, gidx, :], ((0, 0), (0, pad), (0, 0))) if ng else \
+        jnp.zeros((bh, tk, d), kb.dtype)
+    vg = jnp.pad(vb[:, gidx, :], ((0, 0), (0, pad), (0, 0))) if ng else \
+        jnp.zeros((bh, tk, d), vb.dtype)
+    pos = jnp.asarray(
+        np.pad(pos, (0, pad if ng else tk),
+               constant_values=np.int32(2**30)))[None, :]   # [1, NGB]
+
+    # head group: fattest that fits the band score tile (<= ~20 MB under
+    # the raised scoped-vmem limit); prefer sublane-divisible g for the
+    # 2-D lse layout
+    g = 1
+    while (g * 2 <= 8 and bh % (g * 2) == 0 and
+           g * 2 * qtb * BW * block * 4 <= 24 * 1024 * 1024):
+        g *= 2
+    lse2d = (g % 8 == 0)
+
+    kernel = functools.partial(
+        _band_fwd_kernel, sm_scale=sm_scale, block=block, qt=qt, w=w,
+        n_steps=n_steps, tk=tk, g=g, lse2d=lse2d, causal=causal, nq=nq,
+        BW=BW)
+
+    def band_idx(grp, R, st):
+        # all-Element spec (Mosaic rejects mixed Element/Blocked dims):
+        # every coordinate is an ELEMENT offset
+        return (grp * g, jnp.clip(R * qt - (w - 1), 0, nq - BW) * block, 0)
+
+    def gtile_idx(grp, R, st):
+        return (grp, jnp.maximum(st - 1, 0), 0)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh // g, nqs, n_steps),
+        in_specs=[
+            pl.BlockSpec((g, qtb, d), lambda grp, R, st: (grp, R, 0)),
+            pl.BlockSpec((pl.Element(g), pl.Element(BW * block),
+                          pl.Element(d)), band_idx),
+            pl.BlockSpec((pl.Element(g), pl.Element(BW * block),
+                          pl.Element(d)), band_idx),
+            pl.BlockSpec((g, tk, d), gtile_idx),
+            pl.BlockSpec((g, tk, d), gtile_idx),
+            pl.BlockSpec((1, tk), lambda grp, R, st:
+                         (0, jnp.maximum(st - 1, 0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((g, qtb, d), lambda grp, R, st: (grp, R, 0)),
+            (pl.BlockSpec((g, qtb), lambda grp, R, st: (grp, R))
+             if lse2d else
+             pl.BlockSpec((g, qtb, 1), lambda grp, R, st: (grp, R, 0))),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, qtb, 128), jnp.float32),
+            pltpu.VMEM((g, qtb, 128), jnp.float32),
+            pltpu.VMEM((g, qtb, d), jnp.float32),
+        ],
+        compiler_params=_compiler_params("fwd"),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t) if lse2d else (bh, t, 1),
+                                 jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, kg, vg, pos)
+    return out, lse
+
+
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(10, 11, 12, 13, 14, 15, 16, 17))
+                   nondiff_argnums=(10, 11, 12, 13, 14, 15, 16, 17, 18))
 def _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt, qmask,
-              sm_scale, causal, block, interpret, kmax, qmax, g, qt):
-    out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
-                     causal, block, interpret, kmax, g, qt)
+              sm_scale, causal, block, interpret, kmax, qmax, g, qt,
+              band):
+    if band is not None:
+        out, _ = _band_fwd(q, k, v, band, sm_scale, causal, block,
+                           interpret, qt)
+    else:
+        out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
+                         causal, block, interpret, kmax, g[0], qt)
     b, t, h, d = q.shape
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
 def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
                   qmask, sm_scale, causal, block, interpret, kmax, qmax,
-                  g, qt):
-    out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
-                       causal, block, interpret, kmax, g, qt)
+                  g, qt, band):
+    if band is not None:
+        out, lse = _band_fwd(q, k, v, band, sm_scale, causal, block,
+                             interpret, qt)
+    else:
+        out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask,
+                           sm_scale, causal, block, interpret, kmax,
+                           g[0], qt)
     b, t, h, d = q.shape
     out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return out_bthd, (q, k, v, out_bthd, lse, head_map, kidx, kcnt,
@@ -454,9 +725,12 @@ def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
 
 
 def _bs_flash_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp,
-                  qt, res, g):
+                  qt, band, res, g):
+    # the backward always runs the table kernels — they are fast (short
+    # carries, fat tiles) and layout-general; only the forward has a
+    # band+global specialization
     return _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax,
-                   g_grp, qt, res, g)
+                   g_grp[1], qt, res, g)
 
 
 _bs_flash.defvjp(_bs_flash_fwd, _bs_flash_bwd)
@@ -504,16 +778,35 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
     qt = max(1, min(4, 512 // block, nq))
     while nq % qt != 0:
         qt -= 1
+    # VMEM tile budget: the f32 score tile is g*qt*block*block*4 bytes
+    # and operands are double-buffered; the pallas_calls raise the
+    # Mosaic scoped-vmem limit (_VMEM_LIMIT) so fat head-groups fit —
+    # bigger tiles amortize the per-grid-step fixed cost that dominates
+    # short visible-block lists. qt shrinks before the tables are built
+    # (tables are qt-dependent); g shrinks after.
+    while qt > 1 and qt * block * block * 4 > _SCORE_TILE_BUDGET:
+        qt -= 1
+    while qt > 1 and nq % qt != 0:
+        qt -= 1
     (head_map, kidx, kcnt, kmask, qidx, qcnt, qmask, kmax, qmax,
      g) = _build_tables(layout, causal, qt)
     assert h % g == 0 and (b * h) % g == 0  # _build_tables guarantees
-    # VMEM tile budget: the f32 score tile is g*qt*block*block*4 bytes;
-    # keep g*qt*block <= 2048 (16 MB VMEM, double-buffered operands)
-    while g > 1 and g * qt * block > 2048:
+    while g > 1 and g * qt * block * block * 4 > _SCORE_TILE_BUDGET:
         g //= 2
+    # The fwd kernel's online-softmax carry serializes its inner loop,
+    # so it wants OUTER parallelism (many small head-groups keep the
+    # pipeline full at small batch); the bwd kernels have shorter
+    # carries and prefer the fattest tiles. Any divisor of g keeps
+    # layout-uniform groups, so the two passes pick independently
+    # (measured at the 16k bench point: fwd g=2 + bwd g=8 is ~20%
+    # faster than a shared g).
+    g_fwd = g
+    while g_fwd > 1 and (b * h) // g_fwd < _FWD_MIN_OUTER:
+        g_fwd //= 2
+    band = _band_decompose(layout, causal)
     return _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
                      qmask, float(sm_scale), bool(causal), int(block),
-                     bool(interpret), kmax, qmax, g, qt)
+                     bool(interpret), kmax, qmax, (g_fwd, g), qt, band)
 
 
 def block_sparse_attention_dense_fallback(q, k, v, layout, block,

@@ -395,6 +395,77 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ----------------------------------------------------------------------
+# remat-friendly form: never re-run the forward kernel in backward
+# ----------------------------------------------------------------------
+# Under `jax.checkpoint`, a custom_vjp op is atomic: the backward pass
+# re-runs its FORWARD to regenerate residuals, so rematted transformer
+# blocks pay the (expensive, d=64-starved) flash forward kernel twice.
+# The split below routes the residuals AROUND the remat boundary:
+#
+#     out, lse = _flash_outlse(q, k, v)      # fwd kernel, NOT differentiable
+#     out = checkpoint_name(out, "attn_out") # 2 B/elem per layer
+#     lse = checkpoint_name(lse, "attn_lse") # 4 B/token per layer
+#     out = _flash_apply(q, k, v, out, lse)  # identity fwd; custom bwd
+#
+# With a `save_only_these_names:attn_out,attn_lse` policy the named
+# values are saved, `_flash_outlse` is dead in the recompute (its only
+# outputs are saved) and never re-runs, while `_flash_apply`'s VJP runs
+# the dq/dkv kernels directly from the saved residuals — q, k, v are
+# recomputed by the (cheap) qkv-matmul chain remat. Without such a
+# policy the behavior degrades gracefully to plain full remat.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_apply(q, k, v, out, lse, sm_scale, causal, block_q, block_k,
+                 interpret):
+    return out
+
+
+def _flash_apply_fwd(q, k, v, out, lse, sm_scale, causal, block_q,
+                     block_k, interpret):
+    return out, (q, k, v, out, lse)
+
+
+def _flash_apply_bwd(sm_scale, causal, block_q, block_k, interpret,
+                     res, g):
+    dq, dk, dv = _bwd(sm_scale, causal, block_q, block_k, interpret,
+                      res, g)
+    # out/lse enter via the non-differentiable forward kernel (gradient
+    # flows exclusively through q, k, v — mathematically out = f(q,k,v))
+    return dq, dk, dv, jnp.zeros_like(res[3]), jnp.zeros_like(res[4])
+
+
+_flash_apply.defvjp(_flash_apply_fwd, _flash_apply_bwd)
+
+
+def flash_attention_rematerializable(q, k, v, causal=True, sm_scale=None,
+                                     block_q=_DEFAULT_BLOCK,
+                                     block_k=_DEFAULT_BLOCK,
+                                     interpret=None):
+    """flash_attention whose (out, lse) carry checkpoint_name
+    annotations ("attn_out"/"attn_lse") so a names-saving remat policy
+    skips the forward-kernel re-run in backward. Numerics identical to
+    `flash_attention`."""
+    from jax.ad_checkpoint import checkpoint_name
+    assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
+    b, t, h, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(d)
+    if interpret is None:
+        interpret = not _on_tpu()
+    args = (float(sm_scale), bool(causal), int(block_q), int(block_k),
+            bool(interpret))
+
+    out, lse = _fwd(jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
+                    jax.lax.stop_gradient(v), *args)
+    out = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return _flash_apply(q, k, v, out, lse, *args)
+
+
 def flash_attention(q, k, v, causal=True, sm_scale=None,
                     block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK,
                     interpret=None):
