@@ -24,6 +24,8 @@ from deepspeed_tpu.runtime.config import DeepSpeedConfig
 from deepspeed_tpu.runtime.lr_schedules import add_tuning_arguments
 from deepspeed_tpu.utils.distributed import init_distributed
 from deepspeed_tpu.utils.logging import logger, log_dist
+from deepspeed_tpu.runtime.correctness import (ABCorrectnessChecker,
+                                               DivergenceError)
 
 __version_info__ = tuple(int(p) for p in __version__.split("."))
 __git_hash__ = "unknown"

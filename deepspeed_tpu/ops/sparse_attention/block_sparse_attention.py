@@ -324,7 +324,7 @@ def _q_lookup(nk, qmax, num_heads, g):
 
 
 def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
-            block, interpret, kmax, g, qt):
+            block, interpret, kmax, g, qt, allow_lse2d=True):
     b, t, h, d = q.shape
     bh = b * h
     nqs = t // block // qt
@@ -333,7 +333,7 @@ def _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale, causal,
     def to_bht(x):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
 
-    lse2d = (g % 8 == 0)   # 2-D lse blocks need sublane-divisible g
+    lse2d = (g % 8 == 0) and allow_lse2d   # 2-D lse needs sublane-divisible g
     kernel = functools.partial(_bs_fwd_kernel, sm_scale=sm_scale,
                                causal=causal, block=block, num_heads=h,
                                nqs=nqs, kmax=kmax, g=g, qt=qt,
@@ -473,7 +473,7 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
 # ----------------------------------------------------------------------
 # band + global fast path (Longformer/Fixed-class layouts)
 # ----------------------------------------------------------------------
-def _band_decompose(layout, causal, max_globals=64):
+def _band_decompose(layout, causal, max_globals=64, max_band_blocks=64):
     """Causal-folded layout -> (w, global_cols) when it is EXACTLY a
     width-w sliding block window plus a set of globally-visible block
     columns; None otherwise (BigBird random blocks, per-head layouts).
@@ -502,7 +502,9 @@ def _band_decompose(layout, causal, max_globals=64):
     gset = set(gcols)
     off_band = [(i, j) for i, j in zip(rows_i, cols_j) if j not in gset]
     w = max((i - j + 1 for i, j in off_band), default=1)
-    if len(gcols) > max_globals:
+    if len(gcols) > max_globals or w > max_band_blocks:
+        # very wide windows would materialize an unbounded band score
+        # tile; the table path handles them instead
         return None
     # exact reconstruction check (the fast path must not attend extra
     # entries nor drop any)
@@ -599,8 +601,11 @@ def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
             lse_ref[...] = lse_val
 
 
-def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt):
-    """(out [bh,t,d], lse) via the band+global forward."""
+def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
+              allow_lse2d=True):
+    """(out [bh,t,d], lse) via the band+global forward. allow_lse2d:
+    the BACKWARD (table kernels, head group g_bwd) must also be able to
+    address a 2-D lse — callers pass g_bwd's sublane divisibility."""
     w, gcols = band
     b, t, h, d = q.shape
     bh = b * h
@@ -642,7 +647,7 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt):
     while (g * 2 <= 8 and bh % (g * 2) == 0 and
            g * 2 * qtb * BW * block * 4 <= 24 * 1024 * 1024):
         g *= 2
-    lse2d = (g % 8 == 0)
+    lse2d = (g % 8 == 0) and allow_lse2d
 
     kernel = functools.partial(
         _band_fwd_kernel, sm_scale=sm_scale, block=block, qt=qt, w=w,
@@ -700,10 +705,11 @@ def _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt, qmask,
               band):
     if band is not None:
         out, _ = _band_fwd(q, k, v, band, sm_scale, causal, block,
-                           interpret, qt)
+                           interpret, qt, allow_lse2d=(g[1] % 8 == 0))
     else:
         out, _ = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask, sm_scale,
-                         causal, block, interpret, kmax, g[0], qt)
+                         causal, block, interpret, kmax, g[0], qt,
+                         allow_lse2d=(g[1] % 8 == 0))
     b, t, h, d = q.shape
     return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
@@ -713,11 +719,11 @@ def _bs_flash_fwd(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
                   g, qt, band):
     if band is not None:
         out, lse = _band_fwd(q, k, v, band, sm_scale, causal, block,
-                             interpret, qt)
+                             interpret, qt, allow_lse2d=(g[1] % 8 == 0))
     else:
         out, lse = _bs_fwd(q, k, v, head_map, kidx, kcnt, kmask,
                            sm_scale, causal, block, interpret, kmax,
-                           g[0], qt)
+                           g[0], qt, allow_lse2d=(g[1] % 8 == 0))
     b, t, h, d = q.shape
     out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
     return out_bthd, (q, k, v, out_bthd, lse, head_map, kidx, kcnt,

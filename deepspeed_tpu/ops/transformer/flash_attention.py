@@ -437,6 +437,26 @@ def _flash_apply_bwd(sm_scale, causal, block_q, block_k, interpret,
 _flash_apply.defvjp(_flash_apply_fwd, _flash_apply_bwd)
 
 
+def _normalize_flash_args(q, k, v, causal, sm_scale, block_q, block_k,
+                          interpret):
+    """Shared argument validation/defaulting for both flash entry
+    points — they must never diverge (the rematerializable form
+    guarantees identical numerics)."""
+    assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
+    t = q.shape[1]
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, (
+        f"seq_len {t} must divide by block sizes ({block_q}, {block_k}); "
+        "pad the sequence or pass smaller block_q/block_k")
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if interpret is None:
+        interpret = not _on_tpu()
+    return (float(sm_scale), bool(causal), int(block_q), int(block_k),
+            bool(interpret))
+
+
 def flash_attention_rematerializable(q, k, v, causal=True, sm_scale=None,
                                      block_q=_DEFAULT_BLOCK,
                                      block_k=_DEFAULT_BLOCK,
@@ -446,17 +466,9 @@ def flash_attention_rematerializable(q, k, v, causal=True, sm_scale=None,
     skips the forward-kernel re-run in backward. Numerics identical to
     `flash_attention`."""
     from jax.ad_checkpoint import checkpoint_name
-    assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
     b, t, h, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    assert t % block_q == 0 and t % block_k == 0
-    if sm_scale is None:
-        sm_scale = 1.0 / np.sqrt(d)
-    if interpret is None:
-        interpret = not _on_tpu()
-    args = (float(sm_scale), bool(causal), int(block_q), int(block_k),
-            bool(interpret))
+    args = _normalize_flash_args(q, k, v, causal, sm_scale, block_q,
+                                 block_k, interpret)
 
     out, lse = _fwd(jax.lax.stop_gradient(q), jax.lax.stop_gradient(k),
                     jax.lax.stop_gradient(v), *args)
@@ -474,16 +486,5 @@ def flash_attention(q, k, v, causal=True, sm_scale=None,
     interpret=None auto-selects Pallas interpreter mode off-TPU so the
     same kernel code is exercised by CPU tests.
     """
-    assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
-    t = q.shape[1]
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    assert t % block_q == 0 and t % block_k == 0, (
-        f"seq_len {t} must divide by block sizes ({block_q}, {block_k}); "
-        "pad the sequence or pass smaller block_q/block_k")
-    if sm_scale is None:
-        sm_scale = 1.0 / np.sqrt(q.shape[-1])
-    if interpret is None:
-        interpret = not _on_tpu()
-    return _flash(q, k, v, float(sm_scale), bool(causal),
-                  int(block_q), int(block_k), bool(interpret))
+    return _flash(q, k, v, *_normalize_flash_args(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret))

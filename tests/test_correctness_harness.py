@@ -1,0 +1,70 @@
+"""In-situ A/B correctness harness (parity target: ref
+`stage2.py:25,1060` pg_correctness_test — a live A/B of the partitioned
+path against a dense fp32 reference)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import ABCorrectnessChecker, DivergenceError
+from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+
+
+def _setup(**cfg_over):
+    cfg = tiny_gpt2_config(dtype=jnp.bfloat16)
+    model = GPT2ForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})
+    primary = {
+        "train_batch_size": 8,
+        "steps_per_print": 1000,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    }
+    primary.update(cfg_over)
+    return model, params, primary, ids
+
+
+def test_sharded_bf16_agrees_with_fp32_reference():
+    """ZeRO-2 + bf16 must track the plain fp32 ZeRO-0 trajectory on a
+    real model — the reference's pg_correctness_test claim, checked
+    end-to-end."""
+    model, params, primary, ids = _setup()
+    checker = ABCorrectnessChecker(model, params, primary, interval=5,
+                                   loss_atol=0.08, param_rtol=0.02)
+    for i in range(15):
+        checker.train_batch(batch={"input_ids": ids[None]})
+    summary = checker.report()
+    assert summary["checks"] == 3
+    assert summary["max_loss_gap"] <= 0.08
+
+
+def test_divergence_is_detected():
+    """A perturbed primary step must trip the checker (the harness is
+    only useful if it actually fires)."""
+    model, params, primary, ids = _setup()
+    checker = ABCorrectnessChecker(model, params, primary, interval=2,
+                                   loss_atol=0.01)
+    checker.train_batch(batch={"input_ids": ids[None]})
+    # sabotage: perturb the primary's parameters out-of-band
+    checker.primary.state = checker.primary.state._replace(
+        params=jax.tree_util.tree_map(
+            lambda p: p + jnp.asarray(0.5, p.dtype),
+            checker.primary.state.params))
+    with pytest.raises(DivergenceError):
+        checker.train_batch(batch={"input_ids": ids[None]})
+
+
+def test_fp32_primary_agrees_tightly():
+    """With an fp32 primary the only difference is the ZeRO sharding —
+    trajectories must agree to float tolerance."""
+    model, params, primary, ids = _setup()
+    primary.pop("bf16")
+    checker = ABCorrectnessChecker(model, params, primary, interval=4,
+                                   loss_atol=1e-4)
+    for i in range(8):
+        checker.train_batch(batch={"input_ids": ids[None]})
+    assert checker.report()["max_loss_gap"] <= 1e-4

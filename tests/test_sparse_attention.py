@@ -152,3 +152,62 @@ def test_extend_position_embedding():
     assert ext.shape == (300, 16)
     np.testing.assert_array_equal(np.asarray(ext[:128]), np.asarray(pe))
     np.testing.assert_array_equal(np.asarray(ext[128:256]), np.asarray(pe))
+
+
+def test_bslongformer_band_path_matches_fallback():
+    """The BSLongformer causal layout (the bench headline) decomposes
+    into the band+global fast forward; fwd AND grads must match the
+    dense fallback."""
+    from deepspeed_tpu.ops.sparse_attention import BSLongformerSparsityConfig
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        _band_decompose, block_sparse_attention,
+        block_sparse_attention_dense_fallback)
+    cfg = BSLongformerSparsityConfig(num_heads=H, block=BLOCK,
+                                     num_sliding_window_blocks=3)
+    layout = cfg.make_layout(T)
+    assert _band_decompose(layout, True) is not None, \
+        "BSLongformer must take the band fast path"
+    q, k, v = qkv()
+
+    def loss_s(q):
+        return jnp.sum(block_sparse_attention(
+            q, k, v, layout, BLOCK, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_d(q):
+        return jnp.sum(block_sparse_attention_dense_fallback(
+            q, k, v, layout, BLOCK, causal=True).astype(jnp.float32) ** 2)
+
+    np.testing.assert_allclose(float(loss_s(q)), float(loss_d(q)),
+                               rtol=1e-5)
+    gs = jax.grad(loss_s)(q)
+    gd = jax.grad(loss_d)(q)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gd),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_lse2d_branch_with_eight_heads():
+    """bh = 8 engages the 2-D lse layout (g == 8): fwd + grads must
+    still match the fallback (this branch is otherwise TPU-only)."""
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import (
+        block_sparse_attention, block_sparse_attention_dense_fallback)
+    h8 = 8
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(1, T, h8, D), jnp.float32)
+    cfg = FixedSparsityConfig(num_heads=h8, block=BLOCK,
+                              num_local_blocks=2, num_global_blocks=1)
+    layout = cfg.make_layout(T)
+
+    def loss_s(q):
+        return jnp.sum(block_sparse_attention(
+            q, q, q, layout, BLOCK, causal=True).astype(jnp.float32) ** 2)
+
+    def loss_d(q):
+        return jnp.sum(block_sparse_attention_dense_fallback(
+            q, q, q, layout, BLOCK, causal=True).astype(jnp.float32) ** 2)
+
+    np.testing.assert_allclose(float(loss_s(q)), float(loss_d(q)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(jax.grad(loss_s)(q)),
+                               np.asarray(jax.grad(loss_d)(q)),
+                               atol=2e-4, rtol=2e-4)
