@@ -190,7 +190,7 @@ def bench_bert_large():
     import jax.numpy as jnp
     from deepspeed_tpu.models.bert import BertForPreTrainingLM, bert_config
 
-    batch, gas, seq, steps, warmup = 16, 16, 128, 3, 2
+    batch, gas, seq, steps, warmup = 16, 16, 128, 3, 7
     cfg = bert_config("bert-large", max_position_embeddings=seq,
                       hidden_dropout_prob=0.0,
                       attention_probs_dropout_prob=0.0, bf16=True)
@@ -497,6 +497,30 @@ def bench_13b_memory_plan():
             "unsharded_state_gb": round(n_params * 14 / 2**30, 1)}
 
 
+def _measured_matmul_peak():
+    """Best-effort measured bf16 matmul ceiling of THIS chip (a shared
+    / tunneled device often cannot reach the spec-sheet number; MFU
+    against the measured ceiling shows how much of the ATTAINABLE
+    machine the step uses)."""
+    import jax.numpy as jnp
+    m, iters = 4096, 60
+    a = jnp.full((m, m), 0.001, jnp.bfloat16)
+
+    @jax.jit
+    def chain(a):
+        def body(i, c):
+            return (a @ c) * jnp.bfloat16(0.001)
+        return jax.lax.fori_loop(0, iters, body, a)[0, 0]
+
+    _sync(chain(a).astype(jnp.float32))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(chain(a).astype(jnp.float32))
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * m ** 3 * iters / best
+
+
 def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu:
@@ -509,6 +533,18 @@ def main():
     extra = {"flagship_config": "GPT-2 1.5B ZeRO-2, bf16 master-less "
                                 "(fp32 Adam state = 21.8 GB > 16 GB HBM)",
              "achieved_tflops_per_chip": round(achieved / 1e12, 1)}
+    if on_tpu:
+        try:
+            # lower bound on the attainable ceiling: the probe can
+            # itself hit shared-chip contention, but a chip that just
+            # ran the step at `achieved` trivially has peak >= achieved
+            peak_meas = max(_measured_matmul_peak(), achieved)
+            extra["measured_matmul_peak_tflops_lb"] = round(
+                peak_meas / 1e12, 1)
+            extra["mfu_of_measured_peak_ub"] = round(achieved / peak_meas,
+                                                     4)
+        except Exception as e:
+            extra["measured_matmul_peak_tflops_lb"] = f"error: {e}"[:120]
     extras = [("gpt2_13b_zero3_memory_plan", bench_13b_memory_plan)]
     if on_tpu:
         extras = [("gpt2_350m", bench_gpt2_350m),

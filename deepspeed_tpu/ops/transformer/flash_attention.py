@@ -34,8 +34,11 @@ _DEFAULT_BLOCK = 512
 # Heads processed per grid step.  At short T the grid is overhead-bound
 # (each step's matmuls are microseconds), so batching heads into one
 # step cuts the iteration count G-fold; VMEM cost is G * block_q *
-# block_k fp32 for the score tile.
-_DEFAULT_HEAD_GROUP = 4
+# block_k fp32 for the score tile (the pallas calls raise the Mosaic
+# scoped-vmem ceiling to make the fatter tiles legal).
+_DEFAULT_HEAD_GROUP = 8
+_VMEM_LIMIT = 100 * 1024 * 1024
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _on_tpu():
@@ -140,7 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
 
 
-def _head_group(bh, block_q, block_k, d, tile_budget=4 * 1024 * 1024):
+def _head_group(bh, block_q, block_k, d, tile_budget=8 * 1024 * 1024):
     """Largest head-group G (≤ default) dividing B·H, with the fp32 score
     tile capped to `tile_budget` bytes of VMEM (the backward kernels keep
     ~4 score-sized tiles live, so they pass a smaller budget)."""
@@ -169,6 +172,7 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
             pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),
@@ -324,6 +328,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh // g, nk, nq),
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((g, block_q, d), lambda bhi, ki, qi: (bhi, qi, 0)),
             pl.BlockSpec((g, block_k, d), lambda bhi, ki, qi: (bhi, ki, 0)),
@@ -353,6 +358,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh // g, nq, nk),
+        compiler_params=_COMPILER_PARAMS,
         in_specs=[
             pl.BlockSpec((g, block_q, d), lambda bhi, qi, ki: (bhi, qi, 0)),
             pl.BlockSpec((g, block_k, d), lambda bhi, qi, ki: (bhi, ki, 0)),

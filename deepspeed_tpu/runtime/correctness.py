@@ -44,7 +44,15 @@ class ABCorrectnessChecker:
     tolerance (bf16 primaries drift by rounding; fp32 primaries should
     agree to ~1e-5). param_rtol: when set, also compares global
     parameter norms at each check. raise_on_divergence: raise
-    DivergenceError instead of logging a warning."""
+    DivergenceError instead of logging a warning.
+
+    Scope note: the shadow strips the ENGINE's mixed-precision/ZeRO
+    config, but a model whose own config hard-codes a low-precision
+    compute dtype (e.g. GPT2Config(dtype=bfloat16)) still computes in
+    that dtype on BOTH sides — the A/B then certifies the sharded
+    RUNTIME path (partitioned grads, padding, masters, update), not
+    the model's compute precision. Build the model in fp32 to A/B
+    precision as well."""
 
     def __init__(self, model, params, primary_config, mesh=None,
                  interval=10, loss_atol=0.05, param_rtol=None,
@@ -90,6 +98,15 @@ class ABCorrectnessChecker:
         returns the PRIMARY engine's loss."""
         if batch is None:
             assert data_iter is not None
+            if getattr(self.primary, "_is_pipe_module", False) or \
+                    getattr(self.primary, "_pipelined_protocol", False):
+                # pipeline engines collect/reshape batches themselves
+                # and would double-advance a shared iterator — the
+                # caller must materialize full batches for A/B
+                raise ValueError(
+                    "ABCorrectnessChecker with a pipelined model needs "
+                    "batch= (a full batch both engines can consume); "
+                    "the data_iter path would feed them different data")
             gas = self.primary.gradient_accumulation_steps()
             micro = [next(data_iter) for _ in range(gas)]
             batch = jax.tree_util.tree_map(
