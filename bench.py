@@ -535,16 +535,17 @@ def main():
              "achieved_tflops_per_chip": round(achieved / 1e12, 1)}
     if on_tpu:
         try:
-            # lower bound on the attainable ceiling: the probe can
-            # itself hit shared-chip contention, but a chip that just
-            # ran the step at `achieved` trivially has peak >= achieved
-            peak_meas = max(_measured_matmul_peak(), achieved)
-            extra["measured_matmul_peak_tflops_lb"] = round(
-                peak_meas / 1e12, 1)
-            extra["mfu_of_measured_peak_ub"] = round(achieved / peak_meas,
-                                                     4)
+            # raw matmul-peak probe, reported alongside the step's own
+            # achieved TFLOPS. On this shared/tunneled chip the probe
+            # regularly lands BELOW a concurrent training step (seen
+            # 70-143 TF across runs), so no derived ratio is reported —
+            # the nominal-peak MFU above is the stable headline and the
+            # probe documents how far the chip sits from its 197 TF
+            # spec at measurement time.
+            extra["matmul_peak_probe_tflops"] = round(
+                _measured_matmul_peak() / 1e12, 1)
         except Exception as e:
-            extra["measured_matmul_peak_tflops_lb"] = f"error: {e}"[:120]
+            extra["matmul_peak_probe_tflops"] = f"error: {e}"[:120]
     extras = [("gpt2_13b_zero3_memory_plan", bench_13b_memory_plan)]
     if on_tpu:
         extras = [("gpt2_350m", bench_gpt2_350m),

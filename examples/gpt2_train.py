@@ -58,9 +58,18 @@ def synthetic_batches(vocab, micro_bs, gas, seq, seed, num_batches=0):
 
 def main():
     args = get_args()
+    # Selective remat (save matmul outputs) is the throughput sweet spot
+    # up to ~1B params; beyond that the saved activations exceed HBM and
+    # full remat (policy None) is required. bf16 param storage likewise
+    # becomes mandatory at flagship scale (see ds_config_gpt2_1.5b.json).
+    import jax.numpy as jnp
+    big = args.model in ("gpt2-1.5b", "gpt2-2.7b", "gpt2-6.7b", "gpt2-13b")
     cfg = gpt2_config(args.model, n_positions=args.seq_len, dropout=0.0,
                       remat=True,
-                      remat_policy="dots_with_no_batch_dims_saveable")
+                      remat_policy=(None if big else
+                                    "dots_with_no_batch_dims_saveable"),
+                      **({"dtype": jnp.bfloat16,
+                          "param_dtype": jnp.bfloat16} if big else {}))
     model = GPT2ForCausalLM(cfg)
     example = {"input_ids": np.zeros((1, args.seq_len), np.int32)}
     params = model.init(jax.random.PRNGKey(args.seed), example)
