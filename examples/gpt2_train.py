@@ -60,16 +60,16 @@ def main():
     args = get_args()
     # Selective remat (save matmul outputs) is the throughput sweet spot
     # up to ~1B params; beyond that the saved activations exceed HBM and
-    # full remat (policy None) is required. bf16 param storage likewise
-    # becomes mandatory at flagship scale (see ds_config_gpt2_1.5b.json).
+    # full remat (policy None) is required. bf16 param STORAGE likewise
+    # becomes mandatory at flagship scale (see ds_config_gpt2_1.5b.json);
+    # the compute dtype is bf16 at every size.
     import jax.numpy as jnp
     big = args.model in ("gpt2-1.5b", "gpt2-2.7b", "gpt2-6.7b", "gpt2-13b")
     cfg = gpt2_config(args.model, n_positions=args.seq_len, dropout=0.0,
                       remat=True,
                       remat_policy=(None if big else
                                     "dots_with_no_batch_dims_saveable"),
-                      **({"dtype": jnp.bfloat16,
-                          "param_dtype": jnp.bfloat16} if big else {}))
+                      **({"param_dtype": jnp.bfloat16} if big else {}))
     model = GPT2ForCausalLM(cfg)
     example = {"input_ids": np.zeros((1, args.seq_len), np.int32)}
     params = model.init(jax.random.PRNGKey(args.seed), example)
