@@ -165,6 +165,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                              not self._config.bfloat16_master_weights and
                              not (self.zero_optimization() and
                                   self.zero_cpu_offload()))
+        if self.bf16_mode and not self._config.bfloat16_master_weights \
+                and not self.bf16_sr_mode:
+            logger.warning(
+                'bf16 {"master_weights": false} is ignored together '
+                "with cpu_offload — the offload path IS the master "
+                "store (fp32 masters + moments in host RAM); remove "
+                "one of the two settings")
         self.mixed_precision = (self.fp16_mode or self.bf16_mode) and \
             not self.bf16_sr_mode
         self.dynamic_loss_scale_enabled = self.fp16_mode and \
