@@ -27,6 +27,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# The online softmax runs in log2 space: exp2 is the TPU VPU's native
+# transcendental (jnp.exp lowers to exp2(x·log2e) anyway), so folding
+# log2e into the QK^T scale removes one vmul per score element per
+# pass — the softmax VPU chain is a first-order term at d=64, where
+# the MXU work per score element is small. LSE is saved in log2 space;
+# both backward kernels consume it there.
+LOG2E = 1.4426950408889634
 # 512-wide blocks keep the MXU saturated (swept on v5e: 512/512 is ~1.25x
 # over 128/128 and ~1.2x over the dense XLA path at T=2048); VMEM use at
 # d=128 is ~2.5 MB of the 16 MB budget.
@@ -84,6 +91,26 @@ def flash_attention_usable(q, no_dropout: bool,
         t >= 128
 
 
+def _mask_causal(s, causal, qi, ki, block_q, block_k):
+    """Apply the causal mask to a score block — but only when the block
+    actually straddles the diagonal. Blocks fully below the diagonal
+    (max col <= min row) skip the iota/compare/select VPU chain, which
+    at d=64 costs on the order of the exp itself; blocks fully above
+    never reach here (the `visible` guard skipped them)."""
+    if not causal:
+        return s
+    straddles = ki * block_k + block_k - 1 > qi * block_q
+
+    def masked(s):
+        rows = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        return jnp.where((rows >= cols)[None], s, NEG_INF)
+
+    return jax.lax.cond(straddles, masked, lambda s: s, s)
+
+
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
@@ -112,20 +139,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[...]                            # [G, bk, d]
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale  # [G, bq, bk]
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
+            preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+        s = _mask_causal(s, causal, qi, ki, block_q, block_k)
 
         m_prev = m_scr[:, :, :1]                   # [G, bq, 1]
         l_prev = l_scr[:, :, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                     # [G, bq, bk]
-        alpha = jnp.exp(m_prev - m_new)            # [G, bq, 1]
+        p = jnp.exp2(s - m_new)                    # [G, bq, bk]
+        alpha = jnp.exp2(m_prev - m_new)           # [G, bq, 1]
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
 
         v = v_ref[...]                             # [G, bk, d]
@@ -140,7 +162,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _():
         l = l_scr[:, :, :1]
         o_ref[...] = (acc_scr[...] / l).astype(o_ref.dtype)
-        lse_ref[...] = m_scr[:, :, :1] + jnp.log(l)
+        # log2-space LSE (= natural lse · log2e); consumed only by the
+        # backward kernels, which stay in the same space
+        lse_ref[...] = m_scr[:, :, :1] + jnp.log2(l)
 
 
 def _head_group(bh, block_q, block_k, d, tile_budget=8 * 1024 * 1024):
@@ -226,14 +250,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [G, bq, bk]
+            preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+        s = _mask_causal(s, causal, qi, ki, block_q, block_k)
+        p = jnp.exp2(s - lse)                      # [G, bq, bk]
 
         # dV += Pᵀ dO
         dv_scr[...] += jax.lax.dot_general(
@@ -280,14 +299,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         s = jax.lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where((rows >= cols)[None], s, NEG_INF)
-        p = jnp.exp(s - lse)
+            preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+        s = _mask_causal(s, causal, qi, ki, block_q, block_k)
+        p = jnp.exp2(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)

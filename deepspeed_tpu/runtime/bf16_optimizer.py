@@ -113,6 +113,18 @@ def adamw_bf16(learning_rate, b1=0.9, b2=0.999, eps=1e-8,
         weight_decay=weight_decay, state_dtype=state_dtype)
 
 
+def _as_rbg_key(key):
+    """Re-wrap any PRNG key as an `unsafe_rbg` key: its bit generation
+    lowers to XLA RngBitGenerator — the TPU's hardware RNG — instead of
+    a threefry VPU program. At flagship scale the rounding noise covers
+    every param (1.5B+ uint16 draws per step); threefry's ~10+ VPU ops
+    per word made the noise a first-order optimizer-update cost, while
+    dither for rounding needs no cryptographic stream quality."""
+    data = jnp.ravel(jax.random.key_data(key))
+    data = jnp.concatenate([data, data])[:4] if data.size < 4 else data[:4]
+    return jax.random.wrap_key_data(data, impl="unsafe_rbg")
+
+
 def stochastic_round_bf16(x32, key):
     """fp32 -> bf16 with unbiased stochastic rounding: add uniform
     random bits below the 16-bit truncation point, then truncate.
@@ -120,8 +132,8 @@ def stochastic_round_bf16(x32, key):
     mantissa); NaN/inf pass through (their exponent field saturates)."""
     bits = jax.lax.bitcast_convert_type(x32.astype(jnp.float32),
                                         jnp.uint32)
-    noise = jax.random.randint(key, x32.shape, 0, 1 << 16,
-                               dtype=jnp.uint32)
+    noise = jax.random.bits(_as_rbg_key(key), x32.shape,
+                            jnp.uint32) & jnp.uint32(0xFFFF)
     rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
     return jax.lax.bitcast_convert_type(rounded,
                                         jnp.float32).astype(jnp.bfloat16)

@@ -644,7 +644,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # Compute-dtype params keep true shapes; padding is sliced off
         # after each update and on checkpoint save.
         self._zero_pad_plan = {}
-        if self.mixed_precision and not self._offload_enabled():
+        # SR mode shards its bf16 Adam moments (and gas>1 fp32
+        # accumulator) over the data axis exactly like the fp32-master
+        # path, so it needs the same padding for non-divisible leaves.
+        if (self.mixed_precision or self.bf16_sr_mode) and \
+                not self._offload_enabled():
             self._zero_pad_plan = self.zero_policy.pad_plan(params_f32)
             if self._zero_pad_plan:
                 log_dist(
@@ -703,7 +707,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self._initial_params = None   # don't pin the caller's copy
             return
 
-        opt_target = master if self.mixed_precision else params
+        if self.mixed_precision:
+            opt_target = master
+        elif self.bf16_sr_mode and self._zero_pad_plan:
+            # moments live in the padded (encoded) layout so they truly
+            # shard; params themselves keep true shapes for the model
+            opt_target = self.zero_policy.encode(params,
+                                                 self._zero_pad_plan)
+        else:
+            opt_target = params
         opt_state = self.optimizer_transform.init(opt_target)
         if self.lr_scheduler is not None and \
                 "learning_rate" not in getattr(opt_state, "hyperparams", {}):
@@ -873,9 +885,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if transform is None:
             transform = self.optimizer_transform
         scale = state.scale.loss_scale
-        grads = jax.tree_util.tree_map(
-            lambda g: g / scale,
-            grads if grads is not None else state.acc_grads)
+        grads = grads if grads is not None else state.acc_grads
+        if self.fp16_mode:
+            grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+        # else: scale is statically 1.0 — dividing by the traced fp32
+        # scalar would type-promote every bf16 grad leaf to fp32 with two
+        # consumers (norm + update), letting XLA materialize a full fp32
+        # grad tree at peak in SR gas=1 mode
         grad_norm = _global_norm(grads)
         if local_axis is not None:
             w = self.mesh.shape[local_axis]
@@ -890,9 +906,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if clip and clip > 0:
             factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
             factor = jnp.where(jnp.isfinite(factor), factor, 1.0)
-            grads = jax.tree_util.tree_map(lambda g: g * factor, grads)
+            # factor cast to each leaf's dtype: an fp32 scalar multiply
+            # would re-widen bf16 grads outside the fused update chain
+            grads = jax.tree_util.tree_map(
+                lambda g: g * factor.astype(g.dtype), grads)
 
-        opt_target = state.master if self.mixed_precision else state.params
+        sr_padded = self.bf16_sr_mode and bool(self._zero_pad_plan)
+        if self.mixed_precision:
+            opt_target = state.master
+        elif sr_padded:
+            # moments/grads live padded; join them for the update and
+            # slice the padding back off for the stored params
+            opt_target = self.zero_policy.encode(state.params,
+                                                 self._zero_pad_plan)
+        else:
+            opt_target = state.params
 
         def do_update(target, opt_state):
             opt_state = self._with_lr(opt_state, lr)
@@ -938,6 +966,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     new_params, self._param_pspecs_cached)
         else:
             new_master = None
+            if sr_padded:
+                new_target = self.zero_policy.decode(new_target,
+                                                     self._zero_pad_plan)
             new_params = new_target if local_axis is not None else \
                 jax.lax.with_sharding_constraint(
                     new_target, self._param_pspecs_cached)
@@ -1626,16 +1657,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         f"{len(mismatched)} optimizer-state leaves were "
                         "saved at a different world size and were reset "
                         f"(e.g. {mismatched[0][0]} vs {mismatched[0][1]})")
-            if optim_sd.get("scale") is not None:
+            if optim_sd.get("scale") is not None and self.fp16_mode:
+                # only fp16 mode unscales grads; restoring a saved
+                # scale != 1 into a bf16/fp32 engine (e.g. migrating an
+                # fp16 checkpoint) would scale every grad forever
                 scale = LossScaleState(*[jnp.asarray(x)
                                          for x in optim_sd["scale"]])
 
         if self._jit_gas() == 1 and not self._offload_enabled():
             acc_restored = ()
         else:
+            # _params_enc_template is abstract (ShapeDtypeStructs in SR
+            # mode, where no concrete params_f32 tree exists) and already
+            # in the padded/encoded layout — same recipe as _init_state.
             acc_restored = jax.device_put(
-                _zeros_like_f32(self.zero_policy.encode(
-                    params_f32, self._zero_pad_plan)),
+                _zeros_like_f32(self._params_enc_template),
                 self._acc_shardings)
         self.state = EngineState(
             params=params, master=master, opt_state=opt_state, scale=scale,

@@ -190,9 +190,17 @@ class ZeroShardingPolicy:
 
     def encode(self, tree, plan, suffix_match=False):
         """Pad plan leaves to their data-divisible shapes (with zeros —
-        grad norms and optimizer moments are unaffected)."""
+        grad norms and optimizer moments are unaffected). Abstract
+        leaves (ShapeDtypeStruct templates, e.g. the SR-mode fp32
+        template that is never materialized) get padded shapes only."""
         def pad(leaf, entry):
             d, padded, true = entry
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                if d >= len(leaf.shape) or leaf.shape[d] != true:
+                    return leaf
+                shape = list(leaf.shape)
+                shape[d] = padded
+                return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
             if d >= leaf.ndim or leaf.shape[d] != true:
                 return leaf  # already padded, or not a moment-like leaf
             pads = [(0, 0)] * leaf.ndim

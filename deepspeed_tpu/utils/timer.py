@@ -161,7 +161,14 @@ class ThroughputTimer:
                 self.global_step_count % self.steps_per_output < count:
             _device_sync()
             self.end_time = time.time()
-            self.total_elapsed_time = self.end_time - self.start_time
+            window_elapsed = self.end_time - self.start_time
+            # cumulative pair: total_elapsed_time / _measured_steps only
+            # grow at fences, so avg_samples_per_sec is correct when
+            # called mid-window or at end of training (ref ThroughputTimer
+            # accumulated total_elapsed_time the same way)
+            self.total_elapsed_time += window_elapsed
+            self._measured_steps = getattr(self, "_measured_steps", 0) + \
+                (self.global_step_count - self._steps_at_window_start)
             self.logging(
                 "{}/{}, SamplesPerSec={}".format(
                     self.epoch_count, self.micro_step_count,
@@ -172,10 +179,12 @@ class ThroughputTimer:
             self._steps_at_window_start = self.global_step_count
 
     def avg_samples_per_sec(self):
-        base = getattr(self, "_steps_at_window_start", self.start_step)
-        if self.global_step_count > base and self.total_elapsed_time > 0:
+        """Cumulative samples/sec over all completed measurement windows
+        (post-warmup). Safe to call mid-window — unfenced in-flight steps
+        are simply not counted yet."""
+        measured = getattr(self, "_measured_steps", 0)
+        if measured > 0 and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.global_step_count - base
-            avg_time_per_step = self.total_elapsed_time / total_step_offset
+            avg_time_per_step = self.total_elapsed_time / measured
             return samples_per_step / avg_time_per_step
         return float("-inf")

@@ -123,6 +123,91 @@ def test_sr_trajectory_matches_fp32_master():
         (l_sr[-1], l_ref[-1])
 
 
+def test_sr_mode_gas2_checkpoint_resume(tmp_path):
+    """SR mode with gradient_accumulation_steps > 1 must survive a
+    load_checkpoint: the accumulator rebuild used to reference the
+    fp32 tree that only the master-weights branches bind (round-3
+    advisor finding — NameError on resume)."""
+    cfg = tiny_gpt2_config(dtype=jnp.bfloat16)
+    model = GPT2ForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 8, 64)).astype(np.int32)
+
+    def make():
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": ids[0]})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 2,
+                "steps_per_print": 1000,
+                "bf16": {"enabled": True, "master_weights": False},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            })
+        return engine
+
+    engine = make()
+    for _ in range(2):
+        engine.train_batch(batch={"input_ids": ids})
+    engine.save_checkpoint(str(tmp_path), tag="t2")
+    ref_next = float(jax.device_get(
+        engine.train_batch(batch={"input_ids": ids})))
+
+    e2 = make()
+    e2.load_checkpoint(str(tmp_path), tag="t2")
+    got_next = float(jax.device_get(
+        e2.train_batch(batch={"input_ids": ids})))
+    assert abs(got_next - ref_next) < 1e-2, (got_next, ref_next)
+
+
+def test_sr_mode_pad_plan_on_dp_mesh():
+    """On a multi-device data mesh, SR mode must build the ZeRO pad
+    plan (round-3 advisor finding: moments silently replicated) and
+    shard the bf16 moments for non-divisible leaves."""
+    from deepspeed_tpu.runtime.mesh import build_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = build_mesh({"pipe": 1, "data": len(jax.devices()),
+                       "model": 1})
+    cfg = tiny_gpt2_config(dtype=jnp.bfloat16, n_embd=100, n_head=4)
+    model = GPT2ForCausalLM(cfg)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (8, 64)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config={
+            "train_batch_size": 8,
+            "steps_per_print": 1000,
+            "bf16": {"enabled": True, "master_weights": False},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        })
+    assert engine.bf16_sr_mode
+    assert engine._zero_pad_plan, "expected padded leaves at n_embd=100"
+    # every padded moment leaf must actually carry a data-axis sharding
+    keys = sorted(engine._zero_pad_plan, key=len, reverse=True)
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        engine.state.opt_state.inner_state.mu)
+    n_checked = 0
+    for path, leaf in flat:
+        ks = jax.tree_util.keystr(path)
+        if any(ks.endswith(k) for k in keys):
+            spec = leaf.sharding.spec
+            assert any(ax == "data" for ax in spec if ax is not None), \
+                (ks, spec)
+            n_checked += 1
+    assert n_checked, "pad-plan leaves not found in moment tree"
+    # and a step still runs + descends
+    l0 = float(jax.device_get(
+        engine.train_batch(batch={"input_ids": ids[None]})))
+    for _ in range(5):
+        l = float(jax.device_get(
+            engine.train_batch(batch={"input_ids": ids[None]})))
+    assert np.isfinite(l) and l < l0 * 1.5
+
+
 def test_sr_mode_checkpoint_roundtrip(tmp_path):
     """Save/load with bf16 params + bf16 moments: dtypes must survive
     the npz encoding and training must resume bit-compatibly."""
