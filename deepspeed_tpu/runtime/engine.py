@@ -627,13 +627,30 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 else jnp.asarray(x, jnp.float32), self._initial_params)
 
         tp_specs = None
-        if hasattr(self.module, "tp_param_specs"):
+        specs_override = getattr(self, "_param_specs_override", None)
+        if specs_override is not None:
+            # PipelineEngine's per-stage flat layout: flat buffers carry
+            # a pipe-axis spec, tied leaves replicate
+            tp_specs = specs_override(params_f32)
+        elif hasattr(self.module, "tp_param_specs"):
             # TP (and, for pipelined models, pipe-stage) placement; a
             # spec naming a size-1 mesh axis is a no-op, so this is safe
             # for pure-DP meshes too.
             tp_specs = self.module.tp_param_specs(params_f32)
+        # _zero_stage_cap: the flat-stage pipe layout already partitions
+        # parameters (over pipe); stage-3 data-axis param sharding on
+        # top would break the interpreter's local-slice invariant
+        effective_stage = min(self.zero_optimization_stage(),
+                              getattr(self, "_zero_stage_cap", 3))
+        if effective_stage != self.zero_optimization_stage():
+            logger.warning(
+                f"ZeRO stage {self.zero_optimization_stage()} is capped "
+                f"to {effective_stage} under the pipeline's per-stage "
+                "flat parameter layout: parameters are already "
+                "partitioned over the pipe axis; optimizer state / "
+                "gradients still shard over the data axis")
         self.zero_policy = ZeroShardingPolicy(
-            self.mesh, self.zero_optimization_stage(), param_specs=tp_specs)
+            self.mesh, effective_stage, param_specs=tp_specs)
 
         self._param_shardings = self.zero_policy.param_shardings(params_f32)
 
@@ -763,14 +780,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             skipped=jnp.asarray(0, jnp.int32),
             global_steps=jnp.asarray(0, jnp.int32))
 
-        n_params = sum(np.prod(l.shape) for l in
-                       jax.tree_util.tree_leaves(params_f32))
+        n_params = self._count_model_params(params_f32)
         log_dist(
             f"engine initialized: {n_params/1e6:.1f}M params, "
-            f"zero_stage={self.zero_optimization_stage()}, "
+            f"zero_stage={self.zero_policy.stage}, "
             f"dtype={self.compute_dtype.__name__}, "
             f"mesh={dict(self.mesh.shape)}", ranks=[0])
         self._initial_params = None   # don't pin the caller's copy
+
+    def _count_model_params(self, tree):
+        """Model parameter count for logs/profiling; engines whose
+        stored layout carries padding override this."""
+        return sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(tree))
 
     # ------------------------------------------------------------------
     # jitted step functions
@@ -1428,7 +1450,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
         from deepspeed_tpu.profiling.flops_profiler.profiler import num_params
         prof = FlopsProfiler(self.module)
-        prof.total_params = num_params(self.state.params)
+        prof.total_params = self._count_model_params(self.state.params)
         prof.start_profile()
         # fixed key: profiling must not perturb the training RNG stream
         prof_rng = jax.random.PRNGKey(0)
@@ -1483,6 +1505,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def params(self):
         return self.state.params
 
+    def _module_ckpt_template(self):
+        """Template handed to per-layer checkpoint loaders; engines with
+        a non-tree stored layout override this with the logical tree."""
+        return self.state.params
+
+    def _module_from_ckpt(self, tree):
+        """Convert a loaded logical module tree into the engine's stored
+        layout (identity for tree-layout engines)."""
+        return tree
+
     @property
     def fp32_params(self):
         if self._offload_enabled():
@@ -1533,7 +1565,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 self.state.opt_state, self._zero_pad_plan,
                 suffix_match=True),
             scale=jax.device_get(self.state.scale),
-            zero_stage=self.zero_optimization_stage(),
+            # the EFFECTIVE stage (may be capped under pipe flat mode);
+            # checkpoint metadata must describe what actually ran
+            zero_stage=self.zero_policy.stage,
         )
         if self._offload_enabled():
             optim_sd["host_adam"] = self._host_adam.state_dict()
@@ -1566,8 +1600,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             opt_state_template=self.state.opt_state,
             aux_templates=aux_templates)
         if per_layer and "module" not in sd:
-            sd["module"] = self.module.load_state_dir(
-                os.path.join(load_dir, str(tag)), self.state.params)
+            # template/conversion hooks: engines whose stored layout
+            # differs from the module's logical tree (PipelineEngine's
+            # per-stage flat layout) translate here
+            sd["module"] = self._module_from_ckpt(
+                self.module.load_state_dir(
+                    os.path.join(load_dir, str(tag)),
+                    self._module_ckpt_template()))
 
         # Under ZeRO-Offload the fp32 master lives in pinned host memory
         # (state.master is None); rebuilding a device master here would

@@ -95,9 +95,51 @@ class PipelineEngine(DeepSpeedEngine):
         if isinstance(model, PipelineModule):
             self.module = model
             det_accepting = _layers_accepting_deterministic(model)
+            assert model_parameters is not None, (
+                "PipelineModule requires explicit model_parameters "
+                "(pass model_parameters=module.init_params(rng, example))")
+
+            # Per-stage flat parameter storage (pipe/flat_params.py):
+            # active exactly when the compiled 1F1B interpreter will run.
+            # Parameters/grads/optimizer state then divide by the stage
+            # count (ref module.py:197-249 builds only local layers per
+            # process); ZeRO param sharding (stage 3) is capped at 2 —
+            # the pipe axis already partitions the parameters.
+            self._pipe_flat_mode = (
+                self.mesh.shape[PIPE_AXIS] > 1 and
+                self.mesh.shape[MODEL_AXIS] == 1 and
+                self.gradient_accumulation_steps() > 1)
+            if self._pipe_flat_mode:
+                assert model.num_stages == self.mesh.shape[PIPE_AXIS], (
+                    f"PipelineModule was partitioned for "
+                    f"{model.num_stages} stages but the mesh has "
+                    f"pipe={self.mesh.shape[PIPE_AXIS]}; build the "
+                    "module with num_stages matching the pipe axis")
+                from jax.sharding import PartitionSpec
+                from deepspeed_tpu.runtime.pipe.flat_params import \
+                    StageFlatLayout
+                self._pipe_layout = StageFlatLayout(model, model_parameters)
+                model_parameters = self._pipe_layout.flatten(
+                    model_parameters)
+                self._zero_stage_cap = 2
+
+                def _pipe_specs(params_f32):
+                    flat, td = jax.tree_util.tree_flatten_with_path(
+                        params_f32)
+                    specs = [
+                        PartitionSpec(PIPE_AXIS, None)
+                        if jax.tree_util.keystr(path).startswith("['flat']")
+                        else PartitionSpec()
+                        for path, _ in flat]
+                    return jax.tree_util.tree_unflatten(td, specs)
+
+                self._param_specs_override = _pipe_specs
 
             def chained_loss(params, batch, rngs=None, deterministic=False,
                              **_):
+                if getattr(self, "_pipe_flat_mode", False) and \
+                        isinstance(params, dict) and "flat" in params:
+                    params = self._pipe_layout.unflatten(params)
                 inputs, labels = _split_batch(batch)
                 x = inputs
                 for idx in range(len(model.layers)):
@@ -112,9 +154,6 @@ class PipelineEngine(DeepSpeedEngine):
                 return x
 
             self._loss_fn = chained_loss
-            assert model_parameters is not None, (
-                "PipelineModule requires explicit model_parameters "
-                "(pass model_parameters=module.init_params(rng, example))")
             self._initial_params = model_parameters
             return
 
@@ -149,11 +188,8 @@ class PipelineEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------
     def _build_step_fns(self):
         super()._build_step_fns()
-        self._use_1f1b = (
-            self._is_pipe_module and
-            self.mesh.shape[PIPE_AXIS] > 1 and
-            self.mesh.shape[MODEL_AXIS] == 1 and
-            self.gradient_accumulation_steps() > 1)
+        self._use_1f1b = self._is_pipe_module and \
+            getattr(self, "_pipe_flat_mode", False)
         self._interp_fn = None
         if not self._use_1f1b:
             return
@@ -210,7 +246,8 @@ class PipelineEngine(DeepSpeedEngine):
             params_example=self.state.params,
             batch_example=self._interp_example_mb(stacked_batch),
             split_batch=_split_batch,
-            det_accepting=_layers_accepting_deterministic(self.module))
+            det_accepting=_layers_accepting_deterministic(self.module),
+            layout=getattr(self, "_pipe_layout", None))
         log_dist(
             f"PipelineEngine: compiled 1F1B schedule over "
             f"{self.num_stages} stages, {self.micro_batches} "
@@ -242,7 +279,7 @@ class PipelineEngine(DeepSpeedEngine):
             batch_example=self._interp_example_mb(stacked_batch),
             split_batch=_split_batch,
             det_accepting=_layers_accepting_deterministic(self.module),
-            train=False)
+            train=False, layout=getattr(self, "_pipe_layout", None))
         self._eval_interp_jit = cache[sig] = jax.jit(eval_fn)
 
     # ------------------------------------------------------------------
@@ -314,6 +351,40 @@ class PipelineEngine(DeepSpeedEngine):
 
     def set_dataiterator(self, iterator):
         self.data_iterator = iterator
+
+    # -- stored-layout <-> logical-tree translation ---------------------
+    @property
+    def module_params(self):
+        """Compute-dtype parameters as the module's LOGICAL tree
+        (`{"layers", "tied"}`), regardless of the engine's stored
+        layout (the flat-stage layout is an internal storage format)."""
+        p = self.state.params
+        if getattr(self, "_pipe_flat_mode", False):
+            p = self._pipe_layout.unflatten(p)
+        return p
+
+    @property
+    def fp32_params(self):
+        p = DeepSpeedEngine.fp32_params.fget(self)
+        if getattr(self, "_pipe_flat_mode", False):
+            p = self._pipe_layout.unflatten(p)
+        return p
+
+    def _module_ckpt_template(self):
+        if getattr(self, "_pipe_flat_mode", False):
+            return self._pipe_layout.template(self.state.params)
+        return super()._module_ckpt_template()
+
+    def _module_from_ckpt(self, tree):
+        if getattr(self, "_pipe_flat_mode", False):
+            return self._pipe_layout.flatten(tree)
+        return tree
+
+    def _count_model_params(self, tree):
+        if getattr(self, "_pipe_flat_mode", False) and \
+                isinstance(tree, dict) and "flat" in tree:
+            return self._pipe_layout.num_params(tree)
+        return super()._count_model_params(tree)
 
     def module_state_dict(self):
         return _fetch_to_host(self.fp32_params)

@@ -1,0 +1,137 @@
+"""Per-stage flat parameter storage for the compiled 1F1B executor.
+
+The reference's pipeline builds only each stage's local layers on each
+process (`deepspeed/runtime/pipe/module.py:197-249`), so pipeline
+parallelism divides parameter/gradient/optimizer memory by the stage
+count. Under single-controller SPMD the same partitioning is expressed
+as a STORAGE LAYOUT: every stage-exclusive parameter leaf is raveled
+into its stage's flat segment, segments are padded to the widest
+stage and stacked into one `[S, F]` buffer per dtype, and that buffer
+is sharded over the `pipe` mesh axis — each pipe shard's local slice
+IS its stage's parameters, no gather needed. Gradients, fp32 masters,
+and optimizer moments inherit the layout (they are elementwise images
+of the params), so the FULL training state divides by the stage count.
+
+Tied leaves (TiedLayerSpec) are used by several stages; they stay in
+their original tree form, replicated over the pipe axis, with their
+gradients psum-reduced — the compiled form of the reference's
+tied-grad allreduce (`module.py:405-409`), unchanged from before.
+
+The engine stores `{"flat": {dtype: [S, F]}, "tied": <tree>}` as its
+parameter pytree; `unflatten_stage` (static stage id, used inside each
+stage's lax.switch branch) and `unflatten` (full tree, used for
+checkpoint/eval) are exact inverses of `flatten` — ravel/reshape only,
+no value change.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _dt_key(dtype):
+    return np.dtype(dtype).name
+
+
+class StageFlatLayout:
+    """Static description of the per-stage flat layout.
+
+    Built once from the module's partitioning and an example param
+    structure (`{"layers": {idx: tree}, "tied": {key: tree}}` from
+    `PipelineModule.init_params`). All offsets/shapes are recorded at
+    build time; flatten/unflatten are pure reshape/concat programs that
+    work identically on host numpy and inside jit.
+    """
+
+    def __init__(self, module, params_example):
+        self.S = module.num_stages
+        parts = module.parts
+        self._stage_treedefs = []
+        self._stage_meta = []      # per stage: list of (dt_key, offset, shape)
+        sizes = {}                 # dt_key -> per-stage sizes
+        for s in range(self.S):
+            sub = {str(i): params_example["layers"][str(i)]
+                   for i in range(parts[s], parts[s + 1])
+                   if str(i) in params_example.get("layers", {})}
+            leaves, treedef = jax.tree_util.tree_flatten(sub)
+            self._stage_treedefs.append(treedef)
+            meta = []
+            offsets = {}
+            for leaf in leaves:
+                dt = _dt_key(leaf.dtype)
+                off = offsets.get(dt, 0)
+                shape = tuple(np.shape(leaf))
+                meta.append((dt, off, shape))
+                offsets[dt] = off + int(np.prod(shape))
+            self._stage_meta.append(meta)
+            for dt, end in offsets.items():
+                sizes.setdefault(dt, [0] * self.S)[s] = end
+        # padded width per dtype buffer = widest stage
+        self.F = {dt: max(per_stage) for dt, per_stage in sizes.items()}
+
+    def num_params(self, stored):
+        """True parameter count (per-stage padding excluded)."""
+        n = sum(int(np.prod(shape)) for meta in self._stage_meta
+                for _, _, shape in meta)
+        n += sum(int(np.prod(np.shape(l))) for l in
+                 jax.tree_util.tree_leaves(stored.get("tied", {})))
+        return n
+
+    # -- stage-level ----------------------------------------------------
+    def flatten_stage(self, s, stage_tree):
+        """Stage subtree -> {dt: [F_dt]} padded flat vectors."""
+        leaves = jax.tree_util.tree_leaves(stage_tree)
+        segs = {dt: [] for dt in self.F}
+        for (dt, _, shape), leaf in zip(self._stage_meta[s], leaves):
+            segs[dt].append(jnp.ravel(leaf))
+        out = {}
+        for dt in self.F:
+            flat = (jnp.concatenate(segs[dt]) if segs[dt]
+                    else jnp.zeros((0,), dt))
+            out[dt] = jnp.pad(flat, (0, self.F[dt] - flat.shape[0]))
+        return out
+
+    def unflatten_stage(self, s, flat):
+        """{dt: [F_dt]} -> stage subtree (leaves take each buffer's
+        current dtype — the engine casts buffers wholesale, exactly as
+        it casts whole param trees in tree form)."""
+        leaves = []
+        for dt, off, shape in self._stage_meta[s]:
+            n = int(np.prod(shape))
+            leaves.append(flat[dt][off:off + n].reshape(shape))
+        return jax.tree_util.tree_unflatten(self._stage_treedefs[s],
+                                            leaves)
+
+    # -- full-tree ------------------------------------------------------
+    def flatten(self, params):
+        """Full `{"layers", "tied"}` structure -> stored layout
+        `{"flat": {dt: [S, F_dt]}, "tied": tree}`."""
+        bufs = {dt: [] for dt in self.F}
+        for s in range(self.S):
+            stage_flat = self.flatten_stage(
+                s, self._stage_subtree(params, s))
+            for dt in self.F:
+                bufs[dt].append(stage_flat[dt])
+        return {"flat": {dt: jnp.stack(bufs[dt]) for dt in self.F},
+                "tied": params.get("tied", {})}
+
+    def _stage_subtree(self, params, s):
+        # the stage treedef was built from {idx_str: layer_tree}, so
+        # top-level keys identify the stage's layers in the live dict
+        td = self._stage_treedefs[s]
+        example = td.unflatten([0] * td.num_leaves)
+        return {idx_str: params["layers"][idx_str] for idx_str in example}
+
+    def unflatten(self, stored):
+        """Stored layout -> full `{"layers", "tied"}` structure."""
+        layers = {}
+        for s in range(self.S):
+            flat_s = {dt: stored["flat"][dt][s] for dt in self.F}
+            sub = self.unflatten_stage(s, flat_s)
+            layers.update(sub)
+        return {"layers": layers, "tied": stored.get("tied", {})}
+
+    def template(self, stored):
+        """Abstract full-tree template (ShapeDtypeStructs) matching what
+        `unflatten(stored)` would produce — for checkpoint loaders."""
+        return jax.eval_shape(self.unflatten, stored)

@@ -30,17 +30,14 @@ Tied layers (TiedLayerSpec) appear in several stages; each shard
 contributes its stage's grads and the final `psum` over the pipe axis
 IS ReduceTiedGrads (ref `module.py:405-409`).
 
-MEMORY NOTE: params enter the shard_map with spec P() — fully
-REPLICATED across pipe shards — and grads_acc is a full-model tree on
-every shard. The activation-buffer bound above is real, but pipe>1
-buys compute overlap only, NOT the per-stage parameter/gradient memory
-partitioning of the reference's multi-process pipeline. Models whose
-parameters dominate memory should combine this path with the engine's
-ZeRO sharding over the data axis (master/opt state partitioning), or
-use the homogeneous SPMD fast path in `pipe/engine.py`, which shards
-the stacked layer dim over the pipe axis. Sharding per-stage param
-subtrees over the pipe axis inside this interpreter is a known
-follow-up.
+MEMORY: stage-exclusive parameters are stored in the per-stage flat
+layout (`pipe/flat_params.py`) — one `[S, F]` buffer per dtype sharded
+over the pipe axis, so each shard holds only its stage's params, grads
+and optimizer state (the SPMD form of the reference building only
+local layers per process, ref `module.py:197-249`); tied leaves stay
+replicated with psum'd grads. Together with the schedule's
+`num_pipe_buffers()` activation bound, pipe>1 divides both parameter
+and activation memory by the stage count.
 """
 
 import functools
@@ -213,7 +210,7 @@ def _microbatch(tree, mb):
 
 def build_pipeline_step(module, mesh, micro_batches, params_example,
                         batch_example, split_batch, det_accepting,
-                        train=True):
+                        train=True, layout=None):
     """Compile-time construction of the pipelined step function:
     `(params, stacked_batch, rng, loss_scale) -> (loss, grads)` for
     train=True (1F1B), or `... -> loss` for train=False (the fwd-only
@@ -221,7 +218,15 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
     params_example/batch_example: concrete or ShapeDtypeStruct pytrees
     used only for shape inference (batch_example is ONE microbatch).
-    split_batch: callable batch -> (inputs, labels)."""
+    split_batch: callable batch -> (inputs, labels).
+
+    layout (StageFlatLayout): when given, `params` is the per-stage
+    flat storage `{"flat": {dt: [S, F]}, "tied": tree}` sharded over
+    the pipe axis — each shard slices ITS stage's params out of its
+    local [1, F] view (the SPMD form of the reference building only
+    local layers per process, ref module.py:197-249), and gradients
+    come back in the same layout (flat [S, F] per dtype + replicated
+    tied tree). Without it, params are a replicated full tree."""
     S = mesh.shape[PIPE_AXIS]
     m = micro_batches
     tables = build_clock_tables(m, S, train=train)
@@ -241,13 +246,36 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 rngs={"dropout": rng} if rng is not None else None, **kw)
         return x
 
-    # boundary avals: activation entering stage s (s >= 1)
+    # -- param carrier: what the backward differentiates against ------
+    # legacy: the (replicated) full tree itself.  flat layout: the
+    # shard-local flat buffers + the tied tree; `params_of` rebuilds a
+    # stage-sufficient {"layers", "tied"} dict from either.
+    if layout is None:
+        def carrier_of(params):
+            return params
+
+        def params_of(s, carrier):
+            return carrier
+    else:
+        def carrier_of(params):
+            return ({dt: params["flat"][dt][0] for dt in layout.F},
+                    params.get("tied", {}))
+
+        def params_of(s, carrier):
+            flat_local, tied = carrier
+            return {"layers": layout.unflatten_stage(s, flat_local),
+                    "tied": tied}
+
+    # boundary avals: activation entering stage s (s >= 1); shape
+    # inference runs on the logical full tree regardless of storage
+    full_example = params_example if layout is None else \
+        jax.eval_shape(layout.unflatten, params_example)
     bnd = []
     x_aval = jax.eval_shape(lambda x: x, inputs_ex)
     for s in range(S):
         x_aval = jax.eval_shape(
             functools.partial(run_stage, s, deterministic=True, rng=None),
-            params_example, x_aval)
+            full_example, x_aval)
         bnd.append(x_aval)
     # bnd[s] = output of stage s = input of stage s+1
     in_avals = [jax.eval_shape(lambda x: x, inputs_ex)] + bnd[:-1]
@@ -291,7 +319,8 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         def fn(params, act_hold, batch, mb, rng, loss_scale):
             x = stage_input(s, act_hold, batch, mb)
             r = jax.random.fold_in(jax.random.fold_in(rng, mb), s)
-            y = run_stage(s, params, x, r, deterministic=not train)
+            y = run_stage(s, params_of(s, carrier_of(params)), x, r,
+                          deterministic=not train)
             if s == S - 1:
                 _, labels = split_batch(batch)
                 loss = module.loss_fn(y, _microbatch(labels, mb)) \
@@ -301,36 +330,41 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             return to_flat(y), jnp.float32(0.0)
         return fn
 
+    def _grads_f32(dcarrier):
+        return jax.tree_util.tree_map(
+            lambda g_: g_.astype(jnp.float32), dcarrier)
+
     def bwd_fn(s):
         def fn(params, x_saved_flat, grad_hold, batch, mb, rng,
                loss_scale):
             x = stage_input(s, x_saved_flat, batch, mb)
             r = jax.random.fold_in(jax.random.fold_in(rng, mb), s)
+            carrier = carrier_of(params)
 
             if s == S - 1:
-                def g(p, xx):
-                    y = run_stage(s, p, xx, r, deterministic=False)
+                def g(c, xx):
+                    y = run_stage(s, params_of(s, c), xx, r,
+                                  deterministic=False)
                     _, labels = split_batch(batch)
                     loss = module.loss_fn(y, _microbatch(labels, mb)) \
                         if module.loss_fn is not None else y
                     return loss.astype(jnp.float32)
                 cot = loss_scale / m
             else:
-                def g(p, xx):
-                    return run_stage(s, p, xx, r, deterministic=False)
+                def g(c, xx):
+                    return run_stage(s, params_of(s, c), xx, r,
+                                     deterministic=False)
                 cot = from_flat(grad_hold, bnd[s])
 
             if s == 0:
-                _, vjp = jax.vjp(lambda p: g(p, x), params)
-                (dparams,) = vjp(cot)
+                _, vjp = jax.vjp(lambda c: g(c, x), carrier)
+                (dcarrier,) = vjp(cot)
                 dx_flat = jnp.zeros((A,), tdt)
             else:
-                _, vjp = jax.vjp(g, params, x)
-                dparams, dx = vjp(cot)
+                _, vjp = jax.vjp(g, carrier, x)
+                dcarrier, dx = vjp(cot)
                 dx_flat = to_flat(dx)
-            dparams = jax.tree_util.tree_map(
-                lambda g_: g_.astype(jnp.float32), dparams)
-            return dx_flat, dparams
+            return dx_flat, _grads_f32(dcarrier)
         return fn
 
     fwd_fns = [fwd_fn(s) for s in range(S)]
@@ -381,8 +415,10 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 loss = jax.lax.pmean(loss, DATA_AXIS)
             return loss
 
+        # grads carry mirrors the backward carrier: full tree (legacy)
+        # or (local flat buffers, tied tree) under the flat layout
         zeros_grads = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            lambda p: jnp.zeros(p.shape, jnp.float32), carrier_of(params))
 
         def tick(carry, row):
             (act_hold, grad_hold, fwd_out, grad_out, bufs, loss_sum,
@@ -448,21 +484,49 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         loss = jax.lax.psum(loss_sum, PIPE_AXIS) / m
         if dp > 1:
             loss = jax.lax.pmean(loss, DATA_AXIS)
-        # ReduceGrads + ReduceTiedGrads: stage-disjoint leaves psum to
-        # their single producer's value; tied leaves SUM across stages
-        grads = jax.tree_util.tree_map(
-            lambda g_: jax.lax.psum(g_, PIPE_AXIS), carry[6])
-        if dp > 1:
+        if layout is None:
+            # ReduceGrads + ReduceTiedGrads: stage-disjoint leaves psum
+            # to their single producer's value; tied leaves SUM across
+            # stages
             grads = jax.tree_util.tree_map(
-                lambda g_: jax.lax.pmean(g_, DATA_AXIS), grads)
+                lambda g_: jax.lax.psum(g_, PIPE_AXIS), carry[6])
+            if dp > 1:
+                grads = jax.tree_util.tree_map(
+                    lambda g_: jax.lax.pmean(g_, DATA_AXIS), grads)
+        else:
+            # flat grads STAY stage-partitioned (each shard produced
+            # only its stage's segment — no psum, the stacked [S, F]
+            # output is the partitioned gradient store); tied grads SUM
+            # across their user stages (ReduceTiedGrads)
+            flat_g, tied_g = carry[6]
+            tied_g = jax.tree_util.tree_map(
+                lambda g_: jax.lax.psum(g_, PIPE_AXIS), tied_g)
+            if dp > 1:
+                flat_g = jax.tree_util.tree_map(
+                    lambda g_: jax.lax.pmean(g_, DATA_AXIS), flat_g)
+                tied_g = jax.tree_util.tree_map(
+                    lambda g_: jax.lax.pmean(g_, DATA_AXIS), tied_g)
+            grads = {"flat": {dt: flat_g[dt][None] for dt in layout.F},
+                     "tied": tied_g}
         return loss, grads
+
+    if layout is None:
+        params_spec = P()
+        grads_out_spec = P()
+    else:
+        params_spec = {"flat": {dt: P(PIPE_AXIS, None)
+                                for dt in layout.F},
+                       "tied": P()}
+        grads_out_spec = {"flat": {dt: P(PIPE_AXIS, None)
+                                   for dt in layout.F},
+                          "tied": P()}
 
     def step(params, stacked_batch, rng, loss_scale):
         b_specs = stacked_batch_pspecs(stacked_batch)
         return shard_map(
             local_step, mesh=mesh,
-            in_specs=(P(), b_specs, P(), P()),
-            out_specs=(P(), P()) if train else P(),
+            in_specs=(params_spec, b_specs, P(), P()),
+            out_specs=(P(), grads_out_spec) if train else P(),
             check_vma=False)(params, stacked_batch, rng, loss_scale)
 
     return step

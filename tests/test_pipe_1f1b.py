@@ -231,7 +231,7 @@ def test_pipelined_eval_matches_sequential():
     seq = make_engine(num_stages=1, pipe=1, data=8, gas=4)
     # copy trained params over for an apples-to-apples eval
     seq.state = seq.state._replace(params=jax.device_get(
-        engine.state.params))
+        engine.module_params))
     loss_seq = float(jax.device_get(seq.eval_batch(batch=batch)))
     np.testing.assert_allclose(loss_pp, loss_seq, rtol=1e-5)
 
@@ -285,6 +285,89 @@ def test_1f1b_bf16_transport_matches_sequential():
     # the stage boundary (and hence the transport buffer dtype chosen
     # by build_pipeline_step) must actually be bf16
     out = pp.module.apply_layer(
-        0, pp.module.layer_params(jax.device_get(pp.state.params), 0),
+        0, pp.module.layer_params(jax.device_get(pp.module_params), 0),
         jnp.zeros((2, DIN), jnp.float32))
     assert out.dtype == jnp.bfloat16, out.dtype
+
+
+# ----------------------------------------------------------------------
+# per-stage parameter memory partitioning (VERDICT r3 #2; ref
+# module.py:197-249 — pipeline divides param/grad/optimizer memory by
+# the stage count)
+# ----------------------------------------------------------------------
+def test_1f1b_params_partitioned_per_stage():
+    """Under the flat-stage layout every pipe shard must hold only
+    ~total/stages of the stage-exclusive parameter bytes (padding to
+    the widest stage is the only allowed overhead), and the optimizer
+    moments must follow the same layout."""
+    engine = make_engine(num_stages=2, pipe=2, data=4, gas=4)
+    assert getattr(engine, "_pipe_flat_mode", False)
+    stored = engine.state.params
+    assert set(stored) == {"flat", "tied"}
+    layout = engine._pipe_layout
+
+    for dt, buf in stored["flat"].items():
+        S, F = buf.shape
+        assert S == 2
+        # each device's addressable shard holds exactly ONE stage row
+        for shard in buf.addressable_shards:
+            assert shard.data.shape == (1, F), shard.data.shape
+        # and the rows really partition (stage params differ)
+        rows = np.asarray(jax.device_get(buf))
+        assert not np.allclose(rows[0], rows[1])
+
+    # optimizer moments mirror the layout (sharded over pipe, same F)
+    def find_mu(st):
+        if hasattr(st, "mu"):
+            return st.mu
+        if hasattr(st, "inner_state"):
+            return find_mu(st.inner_state)
+        if isinstance(st, (tuple, list)):
+            for item in st:
+                got = find_mu(item)
+                if got is not None:
+                    return got
+        return None
+
+    mu = find_mu(engine.state.opt_state)
+    assert mu is not None
+    for dt, buf in mu["flat"].items():
+        for shard in buf.addressable_shards:
+            assert shard.data.shape == (1, buf.shape[1])
+
+    # the unflattened view equals a fresh logical tree's structure
+    logical = engine.module_params
+    assert set(logical) == {"layers", "tied"}
+
+    # training still descends
+    losses = [float(jax.device_get(
+        engine.train_batch(batch=full_batch(4, seed=i))))
+        for i in range(6)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_1f1b_flat_checkpoint_roundtrip(tmp_path):
+    """Per-layer checkpoint files written from the flat layout reload
+    into a fresh flat-layout engine (and into a SEQUENTIAL engine —
+    files are keyed by layer index, not stage)."""
+    engine = make_engine(num_stages=2, pipe=2, data=4, gas=4)
+    for i in range(3):
+        engine.train_batch(batch=full_batch(4, seed=i))
+    engine.save_checkpoint(str(tmp_path), tag="t3")
+    ref_next = float(jax.device_get(
+        engine.train_batch(batch=full_batch(4, seed=9))))
+
+    e2 = make_engine(num_stages=2, pipe=2, data=4, gas=4, seed=5)
+    e2.load_checkpoint(str(tmp_path), tag="t3")
+    got_next = float(jax.device_get(
+        e2.train_batch(batch=full_batch(4, seed=9))))
+    np.testing.assert_allclose(got_next, ref_next, rtol=1e-4)
+
+    # cross-topology reload: sequential (pipe=1) engine reads the same
+    # per-layer files (ref test_checkpointing.py:633 semantics)
+    e3 = make_engine(num_stages=1, pipe=1, data=8, gas=4, seed=6)
+    e3.load_checkpoint(str(tmp_path), tag="t3",
+                       load_optimizer_states=False)
+    got_seq = float(jax.device_get(
+        e3.train_batch(batch=full_batch(4, seed=9))))
+    np.testing.assert_allclose(got_seq, ref_next, rtol=5e-3)
