@@ -474,14 +474,17 @@ def _bs_bwd(sm_scale, causal, block, interpret, kmax, qmax, g_grp, qt,
 # band + global fast path (Longformer/Fixed-class layouts)
 # ----------------------------------------------------------------------
 def _band_decompose(layout, causal, max_globals=64, max_band_blocks=64):
-    """Causal-folded layout -> (w, global_cols) when it is EXACTLY a
-    width-w sliding block window plus a set of globally-visible block
-    columns; None otherwise (BigBird random blocks, per-head layouts).
+    """Causal-folded layout -> ("sliding"|"aligned", w, global_cols)
+    when it is EXACTLY a width-w block window (sliding band, or
+    window-ALIGNED block-diagonal groups — the reference Fixed
+    pattern's "local" attention, `sparsity_config.py:94`) plus a set
+    of globally-visible block columns; None otherwise (BigBird random
+    blocks, per-head layouts).
 
-    The shipped Fixed and BSLongformer patterns decompose; the fast
+    BSLongformer decomposes as sliding, Fixed as aligned; the fast
     forward then replaces the per-visible-block table walk with ONE
-    contiguous band fetch + regular tiles over the gathered global
-    columns — far fewer, far fatter grid steps."""
+    contiguous band/window fetch + regular tiles over the gathered
+    global columns — far fewer, far fatter grid steps."""
     lay = np.asarray(layout, np.int32)
     if lay.ndim == 3:
         if not (lay == lay[:1]).all():
@@ -500,32 +503,42 @@ def _band_decompose(layout, causal, max_globals=64, max_band_blocks=64):
         if (rows_seeing == expect).all():
             gcols.append(j)
     gset = set(gcols)
-    off_band = [(i, j) for i, j in zip(rows_i, cols_j) if j not in gset]
-    w = max((i - j + 1 for i, j in off_band), default=1)
-    if len(gcols) > max_globals or w > max_band_blocks:
-        # very wide windows would materialize an unbounded band score
-        # tile; the table path handles them instead
+    if len(gcols) > max_globals:
         return None
-    # exact reconstruction check (the fast path must not attend extra
-    # entries nor drop any)
+    off_band = [(i, j) for i, j in zip(rows_i, cols_j) if j not in gset]
     ii = np.arange(nq)[:, None]
     jj = np.arange(nq)[None, :]
-    band = (jj <= ii) & (jj >= ii - w + 1) if causal else \
-        (np.abs(ii - jj) < w)
-    expected = band.copy()
-    for j in gcols:
-        expected[:, j] |= (np.arange(nq) >= j) if causal else True
-    if causal:
-        expected &= np.tril(np.ones_like(expected, dtype=bool))
-    if not np.array_equal(vis, expected):
-        return None
-    return int(w), tuple(int(j) for j in gcols)
+    tril = np.tril(np.ones_like(vis, dtype=bool))
+
+    def matches(base):
+        expected = base.copy()
+        for j in gcols:
+            expected[:, j] |= (np.arange(nq) >= j) if causal else True
+        if causal:
+            expected &= tril
+        return np.array_equal(vis, expected)
+
+    # (a) sliding band of width w
+    w = max((i - j + 1 for i, j in off_band), default=1)
+    if w <= max_band_blocks:
+        band = (jj <= ii) & (jj >= ii - w + 1) if causal else \
+            (np.abs(ii - jj) < w)
+        if matches(band):
+            return "sliding", int(w), tuple(int(j) for j in gcols)
+    # (b) window-aligned block-diagonal of width w: row i sees cols of
+    # its own window floor(i/w) (the Fixed pattern's local part). The
+    # minimal candidate w comes from the same max-offset statistic.
+    for wa in range(max(w, 1), max_band_blocks + 1):
+        aligned = (ii // wa) == (jj // wa)
+        if matches(aligned):
+            return "aligned", int(wa), tuple(int(j) for j in gcols)
+    return None
 
 
 def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
                      o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
                      block, qt, w, n_steps, tk, g, lse2d, causal, nq,
-                     BW):
+                     BW, aligned):
     R = pl.program_id(1)
     st = pl.program_id(2)
     qtb = qt * block
@@ -553,17 +566,27 @@ def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
         s = jax.lax.dot_general(
             q_ref[...], kb_ref[...], (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale
-        # band start (block units) — must mirror the index map exactly
-        S = jnp.clip(R * qt - (w - 1), 0, nq - BW)
+        # band/window start (block units) — must mirror the index map
+        if aligned:
+            S = jnp.clip((R * qt) // w * w, 0, nq - BW)
+        else:
+            S = jnp.clip(R * qt - (w - 1), 0, nq - BW)
         rows = jax.lax.broadcasted_iota(jnp.int32, (qtb, BW * block), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (qtb, BW * block), 1)
         gp = R * qtb + rows
         kp = S * block + cols
-        visible = (kp // block) >= (gp // block - (w - 1))
-        if causal:
-            visible = visible & (kp <= gp)
+        if aligned:
+            # window-aligned local attention (Fixed): same w-window only
+            visible = (kp // block // w) == (gp // block // w)
+            if causal:
+                visible = visible & (kp <= gp)
         else:
-            visible = visible & ((kp // block) <= (gp // block + (w - 1)))
+            visible = (kp // block) >= (gp // block - (w - 1))
+            if causal:
+                visible = visible & (kp <= gp)
+            else:
+                visible = visible & \
+                    ((kp // block) <= (gp // block + (w - 1)))
         s = jnp.where(visible[None], s, NEG_INF)
         online_update(s, vb_ref[...])
 
@@ -575,11 +598,19 @@ def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
         pos = pos_ref[0, :]                       # [tk] source positions
         rows = jax.lax.broadcasted_iota(jnp.int32, (qtb, tk), 0)
         gp = R * qtb + rows
-        # exclude entries the band step already covered (double count)
-        # and the zero-K padding tail (pos is 2**30 there — without the
-        # bound it would pass the non-causal test and add phantom mass)
+        # exclude entries the band/window step already covered (double
+        # count) and the zero-K padding tail (pos is 2**30 there —
+        # without the bound it would pass the non-causal test and add
+        # phantom mass)
         valid = pos[None, :] < nq * block
-        if causal:
+        if aligned:
+            other_window = (pos[None, :] // block // w) != \
+                (gp // block // w)
+            if causal:
+                visible = other_window & (pos[None, :] <= gp) & valid
+            else:
+                visible = other_window & valid
+        elif causal:
             visible = ((pos[None, :] // block) < (gp // block - (w - 1))) \
                 & (pos[None, :] <= gp) & valid
         else:
@@ -606,13 +637,20 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
     """(out [bh,t,d], lse) via the band+global forward. allow_lse2d:
     the BACKWARD (table kernels, head group g_bwd) must also be able to
     address a 2-D lse — callers pass g_bwd's sublane divisibility."""
-    w, gcols = band
+    kind, w, gcols = band
+    aligned = kind == "aligned"
     b, t, h, d = q.shape
     bh = b * h
     nq = t // block
     nqs = nq // qt
     qtb = qt * block
-    BW = min(nq, (w + qt - 1) if causal else (2 * w + qt - 2))
+    if aligned:
+        # caller guarantees qt % w == 0 or w % qt == 0, so a q
+        # super-row's member windows span exactly max(w, qt) block cols
+        assert qt % w == 0 or w % qt == 0, (qt, w)
+        BW = min(nq, max(w, qt))
+    else:
+        BW = min(nq, (w + qt - 1) if causal else (2 * w + qt - 2))
 
     def to_bht(x):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
@@ -652,12 +690,16 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
     kernel = functools.partial(
         _band_fwd_kernel, sm_scale=sm_scale, block=block, qt=qt, w=w,
         n_steps=n_steps, tk=tk, g=g, lse2d=lse2d, causal=causal, nq=nq,
-        BW=BW)
+        BW=BW, aligned=aligned)
 
     def band_idx(grp, R, st):
         # all-Element spec (Mosaic rejects mixed Element/Blocked dims):
         # every coordinate is an ELEMENT offset
-        return (grp * g, jnp.clip(R * qt - (w - 1), 0, nq - BW) * block, 0)
+        if aligned:
+            start = jnp.clip((R * qt) // w * w, 0, nq - BW)
+        else:
+            start = jnp.clip(R * qt - (w - 1), 0, nq - BW)
+        return (grp * g, start * block, 0)
 
     def gtile_idx(grp, R, st):
         return (grp, jnp.maximum(st - 1, 0), 0)
@@ -794,6 +836,17 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
         qt -= 1
     while qt > 1 and nq % qt != 0:
         qt -= 1
+    band = _band_decompose(layout, causal)
+    if band is not None and band[0] == "aligned":
+        # the aligned-window kernel needs super-rows that tile whole
+        # windows (or windows that tile super-rows)
+        w = band[1]
+        while qt > 1 and not (qt % w == 0 or w % qt == 0):
+            qt -= 1
+        while qt > 1 and nq % qt != 0:
+            qt -= 1
+        if not (qt % w == 0 or w % qt == 0):
+            band = None           # qt=1 divides everything; defensive
     (head_map, kidx, kcnt, kmask, qidx, qcnt, qmask, kmax, qmax,
      g) = _build_tables(layout, causal, qt)
     assert h % g == 0 and (b * h) % g == 0  # _build_tables guarantees
@@ -809,7 +862,6 @@ def block_sparse_attention(q, k, v, layout, block, causal=False,
     g_fwd = g
     while g_fwd > 1 and (b * h) // g_fwd < _FWD_MIN_OUTER:
         g_fwd //= 2
-    band = _band_decompose(layout, causal)
     return _bs_flash(q, k, v, head_map, kidx, kcnt, kmask, qidx, qcnt,
                      qmask, float(sm_scale), bool(causal), int(block),
                      bool(interpret), kmax, qmax, (g_fwd, g), qt, band)

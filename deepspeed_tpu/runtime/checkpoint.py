@@ -264,7 +264,10 @@ def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0):
 # ----------------------------------------------------------------------
 def _assemble(flat, shard_entries):
     """Reassemble sharded leaves on host, one leaf at a time (peak host
-    memory = one global leaf, not the whole tree)."""
+    memory = one global leaf, not the whole tree). Coverage is
+    verified: the primary shards of a leaf tile it exactly, so any
+    missing/unreadable bucket file shows up as covered != global and
+    raises instead of silently zero-filling the hole."""
     by_key = {}
     for npz, entry in shard_entries:
         by_key.setdefault(entry["key"], []).append((npz, entry))
@@ -272,12 +275,20 @@ def _assemble(flat, shard_entries):
         _, first = pieces[0]
         out = np.zeros(first["global_shape"],
                        dtype=_np_dtype(first["dtype"]))
+        covered = 0
         for npz, entry in pieces:
             piece = _npz_decode(npz[entry["name"]],
                                 entry.get("npz_dtype"))
             idx = tuple(slice(s, s + d) for s, d in
                         zip(entry["start"], piece.shape))
             out[idx] = piece
+            covered += int(np.prod(piece.shape))
+        total = int(np.prod(first["global_shape"]))
+        if covered != total:
+            raise ValueError(
+                f"checkpoint shard coverage mismatch for {key!r}: "
+                f"{covered} of {total} elements present — a "
+                "zero_pp_rank shard file is missing or truncated")
         flat[key] = out
     return flat
 
@@ -308,6 +319,12 @@ def load_checkpoint_flat(load_dir, tag, mp_rank=0):
     base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
     with open(base + ".json") as f:
         manifest = json.load(f)
+    version = manifest.get("format_version", 1)
+    if version > FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {ckpt_dir} has format_version {version}, but "
+            f"this build reads up to {FORMAT_VERSION} — upgrade "
+            "deepspeed_tpu to load it")
     npz_dtypes = manifest.get("npz_dtypes", {})
     flat = {}
     with np.load(base + ".npz") as main:

@@ -679,15 +679,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._params_enc_template = params_enc
 
         if self.bf16_sr_mode:
-            # cast straight from the caller's params — no fp32 detour;
-            # copy=True keeps the donation contract (same-dtype asarray
-            # of a device array would alias it)
-            params = jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(
-                    jnp.array(x, dtype=self.compute_dtype, copy=True)
-                    if isinstance(x, jax.Array)
-                    else jnp.asarray(x, self.compute_dtype), s),
-                self._initial_params, self._param_shardings)
+            # cast straight from the caller's params — no fp32 detour.
+            # jitted with out_shardings: outputs are fresh buffers (the
+            # donation contract the old copy=True provided) AND born
+            # sharded, so no unsharded cast tree transits HBM/RAM
+            # (25 GB at 13B).
+            params = jax.jit(
+                lambda t: jax.tree_util.tree_map(
+                    lambda x: jnp.asarray(x, self.compute_dtype), t),
+                out_shardings=self._param_shardings)(self._initial_params)
             master = None
         elif self.mixed_precision or self._offload_enabled():
             params = jax.tree_util.tree_map(
@@ -733,16 +733,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                                                  self._zero_pad_plan)
         else:
             opt_target = params
-        opt_state = self.optimizer_transform.init(opt_target)
+        # Shardings are computed from ABSTRACT shapes and the init runs
+        # jitted with out_shardings, so moments are born sharded — an
+        # eager init would materialize the full unsharded moment tree
+        # (100+ GB at 13B) on one device before resharding.
+        opt_shape = jax.eval_shape(self.optimizer_transform.init,
+                                   opt_target)
         if self.lr_scheduler is not None and \
-                "learning_rate" not in getattr(opt_state, "hyperparams", {}):
+                "learning_rate" not in getattr(opt_shape, "hyperparams", {}):
             logger.warning(
                 "an LR scheduler is configured but the client optimizer "
                 "exposes no injectable 'learning_rate' hyperparam "
                 "(wrap it with optax.inject_hyperparams); scheduler values "
                 "will not be applied")
         self._opt_shardings = self.zero_policy.opt_state_shardings(
-            opt_state, self._params_enc_template)
+            opt_shape, self._params_enc_template)
         if self._use_onebit_shardmap:
             self._opt_shardings = self._opt_shardings._replace(
                 worker_error=jax.tree_util.tree_map(
@@ -750,8 +755,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         self.mesh,
                         PartitionSpec(DATA_AXIS,
                                       *([None] * (w.ndim - 1)))),
-                    opt_state.worker_error))
-        opt_state = jax.device_put(opt_state, self._opt_shardings)
+                    opt_shape.worker_error))
+        opt_state = jax.jit(
+            self.optimizer_transform.init,
+            out_shardings=self._opt_shardings)(opt_target)
 
         if self.fp16_mode:
             if self.dynamic_loss_scale_enabled:
@@ -1504,6 +1511,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     @property
     def params(self):
         return self.state.params
+
+    def module_state_dict(self):
+        """Full fp32 module weights on host (ref `engine.py:1248`);
+        multi-host shardings are gathered via process_allgather."""
+        return _fetch_to_host(self.fp32_params)
 
     def _module_ckpt_template(self):
         """Template handed to per-layer checkpoint loaders; engines with

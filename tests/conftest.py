@@ -11,8 +11,14 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = \
-        _flags + " --xla_force_host_platform_device_count=8"
+    _flags += " --xla_force_host_platform_device_count=8"
+if "collective_call_terminate_timeout" not in _flags:
+    # 8 virtual device threads share ONE core here: at big-model scale
+    # (test_zero3_13b full run) they reach a collective's rendezvous
+    # minutes apart, tripping XLA-CPU's default 40 s terminate deadline
+    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=3600"
+               " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600")
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

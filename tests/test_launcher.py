@@ -121,3 +121,61 @@ def test_ds_elastic_cli(tmp_path):
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     assert out.returncode == 0, out.stderr
     assert "final_batch_size" in out.stdout
+
+
+# ----------------------------------------------------------------------
+# failure propagation (ref launch.py:128-167: any child failure kills
+# the group and propagates the exit code)
+# ----------------------------------------------------------------------
+def _launch_cmd(world_info, script_path):
+    import sys
+    from deepspeed_tpu.launcher.runner import encode_world_info
+    return [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+            "--world_info", encode_world_info(world_info),
+            "--node_rank", "0", str(script_path)]
+
+
+def test_launch_propagates_child_failure(tmp_path):
+    import subprocess
+    script = tmp_path / "fail.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    proc = subprocess.run(
+        _launch_cmd({"localhost": [0]}, script),
+        capture_output=True, timeout=60)
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+
+
+def test_launch_sigterm_terminates_child(tmp_path):
+    """SIGTERM to the launcher must terminate the training child and
+    exit 128+15 (ref launch.py:128-167 group kill)."""
+    import os
+    import signal
+    import subprocess
+    import time
+    pid_file = tmp_path / "child.pid"
+    script = tmp_path / "spin.py"
+    script.write_text(
+        "import os, time, pathlib\n"
+        f"pathlib.Path({str(pid_file)!r}).write_text(str(os.getpid()))\n"
+        "time.sleep(300)\n")
+    proc = subprocess.Popen(_launch_cmd({"localhost": [0]}, script),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    deadline = time.time() + 30
+    while not pid_file.exists() and time.time() < deadline:
+        time.sleep(0.1)
+    assert pid_file.exists(), "child never started"
+    child_pid = int(pid_file.read_text())
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=30) == 128 + signal.SIGTERM
+    # the child must be gone (allow a moment for termination delivery)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            os.kill(child_pid, 0)
+            time.sleep(0.1)
+        except ProcessLookupError:
+            break
+    else:
+        os.kill(child_pid, signal.SIGKILL)
+        raise AssertionError("child survived launcher SIGTERM")

@@ -144,3 +144,145 @@ def test_launch_two_process_jax_distributed(tmp_path):
     # same global data + same program -> identical loss on every rank
     assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6, results
     assert results[0]["loss"] < 0.5, results
+
+
+_ZERO2_SCRIPT = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, __REPO__)
+    import deepspeed_tpu
+    import jax, numpy as np
+
+    if os.environ.get("WORLD_SIZE") is not None and \\
+            int(os.environ["WORLD_SIZE"]) > 1:
+        deepspeed_tpu.init_distributed()
+    import jax.numpy as jnp
+    import flax.linen as nn
+
+    CKPT = os.environ["DS_TEST_CKPT_DIR"]
+    PHASE = os.environ["DS_TEST_PHASE"]
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(8)(nn.tanh(nn.Dense(32)(x)))
+
+    class Model:
+        def __init__(self):
+            self.net = Net()
+            x = np.zeros((8, 8), np.float32)
+            self.params = self.net.init(jax.random.PRNGKey(0), x)["params"]
+        def loss_fn(self, params, batch, rngs=None, deterministic=False):
+            y = self.net.apply({"params": params}, batch["x"])
+            return jnp.mean((y - batch["y"]) ** 2)
+
+    m = Model()
+    n_dev = jax.device_count()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, model_parameters=m.params,
+        config={"train_micro_batch_size_per_gpu": 16 // n_dev,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 1000,
+                "zero_optimization": {"stage": 2},
+                "optimizer": {"type": "Adam", "params": {"lr": 2e-2}}})
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 16, 8).astype(np.float32)
+    w = np.linspace(-1, 1, 64).reshape(8, 8).astype(np.float32)
+    batch = {"x": x, "y": x @ w}
+
+    if PHASE == "train_save":
+        for i in range(5):
+            engine.train_batch(batch=batch)
+        engine.save_checkpoint(CKPT, tag="ms")
+        # module_state_dict fetches non-fully-addressable arrays via
+        # process_allgather (engine._fetch_to_host) — checksum must
+        # agree across ranks
+        sd = engine.module_state_dict()
+        checksum = float(sum(np.abs(np.asarray(l)).sum()
+                             for l in jax.tree_util.tree_leaves(sd)))
+        loss_next = float(jax.device_get(
+            engine.train_batch(batch=batch)))
+    else:
+        engine.load_checkpoint(CKPT, tag="ms")
+        checksum = 0.0
+        loss_next = float(jax.device_get(
+            engine.train_batch(batch=batch)))
+
+    print("SMOKE_RESULT:" + json.dumps({
+        "rank": os.environ.get("RANK", "0"),
+        "n_devices": n_dev,
+        "checksum": round(checksum, 6),
+        "loss_next": round(loss_next, 8)}), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_multiprocess_zero2_checkpoint_respawn(tmp_path):
+    """VERDICT r3 #5: 2 processes x 4 CPU devices each run a ZeRO-2
+    engine (moments sharded over the 8-device data axis spanning both
+    processes), train, save a checkpoint where each process writes
+    only its addressable shards, and a DIFFERENT process split (1
+    process x 8 devices) reloads it and continues — losses must agree.
+    Also executes engine._fetch_to_host's process_allgather
+    (module_state_dict on non-fully-addressable arrays)."""
+    from deepspeed_tpu.launcher.runner import encode_world_info
+    import socket
+    script = tmp_path / "zero2_train.py"
+    script.write_text(_ZERO2_SCRIPT.replace("__REPO__", repr(REPO)))
+    ckpt_dir = tmp_path / "ckpt"
+
+    world = encode_world_info({"nodeA": [0], "nodeB": [0]})
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = []
+    for rank in range(2):
+        env = _base_env()
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["DS_TEST_CKPT_DIR"] = str(ckpt_dir)
+        env["DS_TEST_PHASE"] = "train_save"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+             "--world_info", world, "--node_rank", str(rank),
+             "--master_addr", "127.0.0.1", "--master_port", str(port),
+             str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=REPO))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    results = [_parse(o[1]) for o in outs]
+    assert all(o[0] == 0 for o in outs) and all(results), \
+        [(o[0], o[1][-400:], o[2][-800:]) for o in outs]
+    assert all(r["n_devices"] == 8 for r in results), results
+    # process_allgather produced the same full tree on both ranks
+    assert results[0]["checksum"] == results[1]["checksum"], results
+    # both ranks agree on the post-checkpoint loss
+    assert abs(results[0]["loss_next"] - results[1]["loss_next"]) < 1e-7
+
+    # each process wrote only its addressable shards: with 8 dp
+    # ordinals split 4/4, optimizer shard buckets must exist for all 8
+    import glob as _glob
+    buckets = _glob.glob(str(ckpt_dir / "ms" / "zero_pp_rank_*optim*.npz"))
+    assert len(buckets) == 8, sorted(os.path.basename(b) for b in buckets)
+
+    # phase 2: different split (1 process x 8 devices) reloads
+    env = _base_env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["DS_TEST_CKPT_DIR"] = str(ckpt_dir)
+    env["DS_TEST_PHASE"] = "load"
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, timeout=600,
+                          env=env, cwd=REPO)
+    res = _parse(proc.stdout)
+    assert proc.returncode == 0 and res, \
+        (proc.returncode, proc.stdout[-400:], proc.stderr[-800:])
+    assert res["n_devices"] == 8
+    # the reloaded engine's next-step loss matches the saved run's
+    assert abs(res["loss_next"] - results[0]["loss_next"]) < 1e-5, \
+        (res, results[0])

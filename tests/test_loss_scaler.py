@@ -107,3 +107,103 @@ def test_clean_window_restores_hysteresis():
     scale_before = float(s.loss_scale)
     s = update_loss_scale(s, True, scale_window=4, delayed_shift=2)
     assert float(s.loss_scale) == scale_before
+
+
+# ----------------------------------------------------------------------
+# ENGINE-level trajectory exactness (ref test_dynamic_loss_scale.py:
+# the reference drives a real engine with injected gradients and
+# asserts cur_scale after every step; so do we)
+# ----------------------------------------------------------------------
+class _GradInjector:
+    """loss = sum(w * v): grad(w) == batch value, so inf/nan batches
+    force overflow exactly like the reference's p.grad.fill_(value)."""
+
+    def init(self, rng, batch):
+        return {"w": jnp.ones((4,), jnp.float32)}
+
+    def loss_fn(self, params, batch, rngs=None, deterministic=True, **_):
+        return jnp.sum(params["w"] * batch["v"].astype(jnp.float32))
+
+
+def _scale_engine(initial_scale_power, window):
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.mesh import build_mesh
+    model = _GradInjector()
+    params = model.init(None, None)
+    mesh = build_mesh({"pipe": 1, "data": 1, "model": 1},
+                      devices=jax.devices()[:1])   # ref world_size=1
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, mesh=mesh,
+        config={
+            "train_batch_size": 1,
+            "steps_per_print": 1000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1.5e-4}},
+            # hysteresis 1 = the reference FUSED optimizer's behavior
+            # (halve on every overflow), which is what its trajectory
+            # tests assert; the default 2 matches its unfused
+            # DynamicLossScaler
+            "fp16": {"enabled": True, "loss_scale": 0,
+                     "initial_scale_power": initial_scale_power,
+                     "loss_scale_window": window,
+                     "hysteresis": 1},
+        })
+    return engine
+
+
+def _step(engine, value):
+    batch = {"v": np.full((1, 4), value, np.float32)}
+    loss = engine(batch)
+    engine.backward(loss)
+    engine.step()
+    return float(jax.device_get(engine.state.scale.loss_scale))
+
+
+def test_engine_no_overflow_schedule():
+    """Clean steps double the scale every `window` steps (ref
+    test_fused_no_overflow)."""
+    window = 2
+    engine = _scale_engine(initial_scale_power=8, window=window)
+    expected = 2.0 ** 8
+    assert float(jax.device_get(engine.state.scale.loss_scale)) == expected
+    for i, value in enumerate(np.random.uniform(-0.1, 0.1, 10)):
+        got = _step(engine, value)
+        if (i + 1) % window == 0:
+            expected *= 2
+        assert got == expected, (i, got, expected)
+    assert engine.skipped_steps == 0
+
+
+def test_engine_all_overflow_schedule():
+    """Every overflow halves the scale (floor 1) and skips the step
+    (ref test_fused_all_overflow)."""
+    engine = _scale_engine(initial_scale_power=4, window=2)
+    expected = 2.0 ** 4
+    bad = [np.inf, -np.inf] + [np.nan] * 6
+    for i, value in enumerate(bad):
+        got = _step(engine, value)
+        expected = max(expected / 2, 1.0)
+        assert got == expected, (i, got, expected)
+    assert engine.skipped_steps == len(bad)
+
+
+def test_engine_some_overflow_schedule():
+    """Mixed trace: consecutive overflows halve twice, then
+    window+1 clean steps raise once, then one more overflow halves
+    (ref test_fused_some_overflow)."""
+    window = 2
+    engine = _scale_engine(initial_scale_power=8, window=window)
+    expected = 2.0 ** 8
+
+    for value in (np.inf, np.nan):
+        got = _step(engine, value)
+    expected /= 4
+    assert got == expected
+
+    for value in np.random.uniform(-0.1, 0.1, window + 1):
+        got = _step(engine, value)
+    expected *= 2          # exactly one doubling in window+1 steps
+    assert got == expected
+
+    got = _step(engine, np.inf)
+    expected /= 2
+    assert got == expected

@@ -168,3 +168,68 @@ def test_legacy_pickle_checkpoint_still_loads(tmp_path):
     path, _ = engine.load_checkpoint(str(tmp_path))
     assert path is not None
     assert engine.global_steps == 7
+
+
+# ----------------------------------------------------------------------
+# format versioning + corruption detection (VERDICT r3 #10)
+# ----------------------------------------------------------------------
+def _find_one(pattern, tmp_path):
+    files = glob.glob(os.path.join(str(tmp_path), "**", pattern),
+                      recursive=True)
+    assert files, pattern
+    return files[0]
+
+
+def test_format_version_written_and_future_rejected(tmp_path):
+    from deepspeed_tpu.runtime.checkpoint import FORMAT_VERSION
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=2)
+    engine.train_batch(batch={"input_ids": ids[None]})
+    engine.save_checkpoint(str(tmp_path), tag="v")
+
+    # exact main-manifest name: a bare '*model_states.json' would also
+    # match shard-bucket manifests, which the loader never version-checks
+    manifest_path = _find_one("mp_rank_*_model_states.json", tmp_path)
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["format_version"] == FORMAT_VERSION
+
+    # bump to a future version: load must fail with a clear error
+    manifest["format_version"] = FORMAT_VERSION + 1
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    engine2, _ = _make_engine(mesh, stage=2)
+    with pytest.raises(ValueError, match="format_version"):
+        engine2.load_checkpoint(str(tmp_path), tag="v")
+
+
+def test_missing_shard_file_detected(tmp_path):
+    """Deleting one zero_pp_rank shard bucket must raise a coverage
+    error, not silently zero-fill the hole."""
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=2)
+    engine.train_batch(batch={"input_ids": ids[None]})
+    engine.save_checkpoint(str(tmp_path), tag="v")
+
+    shard = _find_one("zero_pp_rank_1_*.npz", tmp_path)
+    os.remove(shard)
+    os.remove(shard[:-len(".npz")] + ".json")
+    engine2, _ = _make_engine(mesh, stage=2)
+    with pytest.raises(ValueError, match="coverage"):
+        engine2.load_checkpoint(str(tmp_path), tag="v")
+
+
+def test_truncated_shard_file_detected(tmp_path):
+    """A truncated shard npz must raise, not load garbage."""
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, ids = _make_engine(mesh, stage=2)
+    engine.train_batch(batch={"input_ids": ids[None]})
+    engine.save_checkpoint(str(tmp_path), tag="v")
+
+    shard = _find_one("zero_pp_rank_0_*.npz", tmp_path)
+    data = open(shard, "rb").read()
+    with open(shard, "wb") as f:
+        f.write(data[:max(16, len(data) // 3)])
+    engine2, _ = _make_engine(mesh, stage=2)
+    with pytest.raises(Exception):
+        engine2.load_checkpoint(str(tmp_path), tag="v")

@@ -211,3 +211,19 @@ def test_lse2d_branch_with_eight_heads():
     np.testing.assert_allclose(np.asarray(jax.grad(loss_s)(q)),
                                np.asarray(jax.grad(loss_d)(q)),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_fixed_pattern_rides_band_fast_path():
+    """VERDICT r3 #7: the reference's default Fixed pattern (window-
+    ALIGNED local blocks + summary columns) must decompose onto the
+    band+global fast forward, like BSLongformer's sliding window."""
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    from deepspeed_tpu.ops.sparse_attention.block_sparse_attention import \
+        _band_decompose
+    cfg = FixedSparsityConfig(num_heads=4, block=128, num_local_blocks=4,
+                              num_global_blocks=1)
+    lay = cfg.make_layout(4096)
+    for causal in (True, False):
+        band = _band_decompose(lay, causal)
+        assert band is not None and band[0] == "aligned", (causal, band)
+        assert band[1] == 4  # the window width in blocks
