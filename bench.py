@@ -371,11 +371,17 @@ def bench_offload_real_step():
 
 
 def bench_pipe_interp_vs_spmd():
-    """Same homogeneous model through the compiled 1F1B interpreter vs
-    the SPMD scan fast path. Pipeline parallelism needs pipe >= 2;
-    with one real chip the comparison runs in a subprocess on an
-    8-device virtual CPU mesh — the RATIO (schedule efficiency) is the
-    metric, not absolute time."""
+    """Same homogeneous model through the compiled 1F1B interpreter
+    (the recommended substrate — see pipe/engine.py docstring) vs the
+    GPipe SPMD scan. Pipeline parallelism needs pipe >= 2; with one
+    real chip the comparison runs in a subprocess on an 8-device
+    virtual CPU mesh. NOTE on reading the ratio: the virtual mesh
+    SERIALIZES stages onto one core, so the scan's fill/drain bubble
+    ((S-1)/m of extra stage-executions on garbage inputs) shows up as
+    real compute time here, while on parallel hardware both paths pay
+    the bubble as idle stages; the interp's win is therefore an upper
+    bound, but its activation bound and per-stage param partitioning
+    hold everywhere."""
     import subprocess
     import sys
     script = r"""
