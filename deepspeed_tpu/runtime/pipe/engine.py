@@ -19,7 +19,8 @@ communication are *compiled*:
     measures faster end-to-end on the same model (bench
     `pipe_interp_vs_spmd`: 1918 ms vs 2758 ms — on the serialized
     virtual test mesh the scan's fill/drain bubble executes as real
-    garbage compute, (S-1)/m = 1.375x, matching the measured 1.44x;
+    garbage compute, an overhead factor of 1 + (S-1)/m = 1.375x,
+    matching the measured 1.44x;
     on parallel hardware both paths pay the bubble as idle stages, so
     the gap narrows but never inverts). On a pipe=1 mesh the layer
     chain runs sequentially inside the fused step (pure microbatching
