@@ -580,15 +580,49 @@ def bench_offload_overlap():
             _sync(out[0])
             outs.append(out)
 
-    pipelined()  # warmup both programs
+    def d2h_only():
+        chunks = [flat[lo:hi] for lo, hi in bounds]
+        for c in chunks:
+            c.copy_to_host_async()
+        for c in chunks:
+            np.asarray(c).astype(np.float32, copy=False)
+
+    def h2d_only():
+        outs = [jnp.asarray(master[lo:hi].copy()) for lo, hi in bounds]
+        _sync(jnp.concatenate(outs)[0])
+
+    def compute_only(g_host):
+        adam.begin_step()
+        for lo, hi in bounds:
+            adam.step_chunk(lo, hi, master[lo:hi], g_host[lo:hi], lr=1e-4)
+
+    g_host = np.asarray(flat).astype(np.float32, copy=False)
+    pipelined()  # warmup all programs
     sequential()
+    compute_only(g_host)
     t_pipe = min(timeit_once(pipelined) for _ in range(3))
     t_seq = min(timeit_once(sequential) for _ in range(3))
+    t_d2h = min(timeit_once(d2h_only) for _ in range(3))
+    t_h2d = min(timeit_once(h2d_only) for _ in range(3))
+    t_comp = min(timeit_once(lambda: compute_only(g_host))
+                 for _ in range(3))
+    # ideal 3-stage pipelined wall = the slowest leg (plus fill);
+    # measured_pipelined approaches it as the link approaches
+    # real-hardware speeds (on this ~10-20 MB/s tunnel the transfers
+    # are ~99% of the wall, so the measured speedup mostly reflects
+    # round-trip latency hiding — the leg decomposition is the
+    # portable number)
+    legs = (t_d2h, t_comp, t_h2d)
+    ideal = sum(legs) / max(max(legs), 1e-9)
     return {"bytes_on_wire_mb": round(n * 2 / 2**20, 1),
             "chunks": len(bounds),
             "sequential_s": round(t_seq, 2),
             "pipelined_s": round(t_pipe, 2),
-            "overlap_speedup": round(t_seq / t_pipe, 2)}
+            "measured_overlap_speedup": round(t_seq / t_pipe, 2),
+            "d2h_only_s": round(t_d2h, 2),
+            "h2d_only_s": round(t_h2d, 2),
+            "compute_only_s": round(t_comp, 2),
+            "ideal_overlap_speedup": round(ideal, 2)}
 
 
 def timeit_once(fn):
