@@ -538,7 +538,7 @@ def _band_decompose(layout, causal, max_globals=64, max_band_blocks=64):
 def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
                      o_ref, lse_ref, m_scr, l_scr, acc_scr, *, sm_scale,
                      block, qt, w, n_steps, tk, g, lse2d, causal, nq,
-                     BW, aligned):
+                     BW, aligned, max_live=None):
     R = pl.program_id(1)
     st = pl.program_id(2)
     qtb = qt * block
@@ -590,7 +590,23 @@ def _band_fwd_kernel(q_ref, kb_ref, vb_ref, kg_ref, vg_ref, pos_ref,
         s = jnp.where(visible[None], s, NEG_INF)
         online_update(s, vb_ref[...])
 
-    @pl.when(st > 0)
+    # causal: gathered global columns are position-sorted, so a tile
+    # whose FIRST position exceeds the super-row's last query position
+    # is fully invisible — skip its matmul outright (for the Fixed
+    # pattern the per-row visible-summary count grows with position,
+    # and this turns the global sweep's triangular waste into skipped
+    # steps, ~halving global work at long T). With the regular-globals
+    # index clamp (`max_live`) the liveness MUST come from the closed
+    # form: dead steps re-fetch the last LIVE tile (so Pallas elides
+    # the DMA), whose pos entries would wrongly pass the runtime test.
+    tile_live = True
+    if causal:
+        if max_live is not None:
+            tile_live = st - 1 <= max_live(R)
+        else:
+            tile_live = pos_ref[0, 0] <= (R + 1) * qtb - 1
+
+    @pl.when(jnp.logical_and(st > 0, tile_live))
     def _():
         s = jax.lax.dot_general(
             q_ref[...], kg_ref[...], (((2,), (2,)), ((0,), (0,))),
@@ -687,10 +703,31 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
         g *= 2
     lse2d = (g % 8 == 0) and allow_lse2d
 
+    # Regularly-spaced globals (the Fixed pattern: one summary column
+    # per w-block window => gcols is the stride-w progression ending
+    # each window) admit a CLOSED FORM for "last live global tile of
+    # super-row R" under causality: tile sti's first source position is
+    # sti*(tk//block)*w*block + (w-1)*block. Clamping the index maps to
+    # that bound makes dead steps refetch the PREVIOUS tile — which
+    # Pallas elides as a revisit — so causally dead tiles cost neither
+    # MXU nor DMA (review r4: the in-kernel guard alone still streamed
+    # g*tk*d*2 bytes of K and V per dead step).
+    regular_globals = bool(
+        causal and gcols and tk % block == 0 and
+        tuple(gcols) == tuple(w - 1 + m * w for m in range(len(gcols))))
+    blocks_per_tile = tk // block if tk % block == 0 else 0
+
+    def max_live_tile(R):
+        # largest sti with first_pos(sti) <= (R+1)*qtb - 1, in 0-based
+        # global-tile units (st = sti + 1 in the grid)
+        return ((R + 1) * qtb - 1 - (w - 1) * block) // \
+            (blocks_per_tile * w * block)
+
     kernel = functools.partial(
         _band_fwd_kernel, sm_scale=sm_scale, block=block, qt=qt, w=w,
         n_steps=n_steps, tk=tk, g=g, lse2d=lse2d, causal=causal, nq=nq,
-        BW=BW, aligned=aligned)
+        BW=BW, aligned=aligned,
+        max_live=max_live_tile if regular_globals else None)
 
     def band_idx(grp, R, st):
         # all-Element spec (Mosaic rejects mixed Element/Blocked dims):
@@ -701,8 +738,14 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
             start = jnp.clip(R * qt - (w - 1), 0, nq - BW)
         return (grp * g, start * block, 0)
 
+    def gtile(R, st):
+        sti = jnp.maximum(st - 1, 0)
+        if regular_globals:
+            sti = jnp.clip(sti, 0, jnp.maximum(max_live_tile(R), 0))
+        return sti
+
     def gtile_idx(grp, R, st):
-        return (grp, jnp.maximum(st - 1, 0), 0)
+        return (grp, gtile(R, st), 0)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -715,8 +758,7 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
                           pl.Element(d)), band_idx),
             pl.BlockSpec((g, tk, d), gtile_idx),
             pl.BlockSpec((g, tk, d), gtile_idx),
-            pl.BlockSpec((1, tk), lambda grp, R, st:
-                         (0, jnp.maximum(st - 1, 0))),
+            pl.BlockSpec((1, tk), lambda grp, R, st: (0, gtile(R, st))),
         ],
         out_specs=[
             pl.BlockSpec((g, qtb, d), lambda grp, R, st: (grp, R, 0)),
