@@ -34,10 +34,12 @@ NEG_INF = -1e30
 # the MXU work per score element is small. LSE is saved in log2 space;
 # both backward kernels consume it there.
 LOG2E = 1.4426950408889634
-# 512-wide blocks keep the MXU saturated (swept on v5e: 512/512 is ~1.25x
-# over 128/128 and ~1.2x over the dense XLA path at T=2048); VMEM use at
-# d=128 is ~2.5 MB of the 16 MB budget.
-_DEFAULT_BLOCK = 512
+# Block sizes swept on v5e at the flagship shape (B8 T1024 H25 d64,
+# round 4): 1024/1024 beats 512/512 by ~3.5% fwd+bwd and — decisively —
+# makes T<=1024 a SINGLE tile, which routes the backward through the
+# fused one-pass kernel below (no second s/p/dp recompute sweep). For
+# longer T the per-call min(block, T) keeps tiles at 1024.
+_DEFAULT_BLOCK = 1024
 # Heads processed per grid step.  At short T the grid is overhead-bound
 # (each step's matmuls are microseconds), so batching heads into one
 # step cuts the iteration count G-fold; VMEM cost is G * block_q *
@@ -76,6 +78,16 @@ def dense_attention(q, k, v, mask=None, causal=False, sm_scale=None,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _fit_block(block, t):
+    """Largest power-of-two shrink of `block` (floor 128) that divides
+    t, after clamping to t — so T=1536 gets 512-wide tiles instead of
+    failing the 1024 default."""
+    block = min(block, t)
+    while block > 128 and t % block:
+        block //= 2
+    return block
+
+
 def flash_attention_usable(q, no_dropout: bool,
                            block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK):
     """The kernel handles [B, T, H, D] with T divisible by the block size
@@ -85,8 +97,8 @@ def flash_attention_usable(q, no_dropout: bool,
     if q.ndim != 4:
         return False
     t, d = q.shape[1], q.shape[3]
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     return t % block_q == 0 and t % block_k == 0 and d % 64 == 0 and \
         t >= 128
 
@@ -186,6 +198,10 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         return x.transpose(0, 2, 1, 3).reshape(bh, t, d)
     qt, kt, vt = to_bht(q), to_bht(k), to_bht(v)
 
+    # 8 MB score-tile budget. A 24 MB budget (g=5 at the flagship
+    # shape) measures ~20% faster on the ISOLATED kernel chain but ~1%
+    # slower inside the full train step (VMEM pressure against the
+    # surrounding fusions) — keep the in-model winner.
     g = _head_group(bh, block_q, block_k, d)
     nq, nk = t // block_q, t // block_k
     grid = (bh // g, nq, nk)
@@ -315,6 +331,40 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[...] = dq_scr[...].astype(dq_ref.dtype)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, sm_scale, causal,
+                      block_q, block_k):
+    """Single-tile backward (T == block): s, p and dP exist once, so
+    dQ, dK and dV all come out of ONE pass — the two-kernel flash
+    backward recomputes s/p (and dP) in each sweep, paying ~2x the
+    matmul+exp work at tiles the VMEM can hold whole."""
+    q = q_ref[...]
+    k = k_ref[...]
+    v = v_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...]
+    delta = delta_ref[...]
+
+    s = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * (sm_scale * LOG2E)
+    s = _mask_causal(s, causal, 0, 0, block_q, block_k)
+    p = jnp.exp2(s - lse)
+    dv_ref[...] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * sm_scale
+    dk_ref[...] = jax.lax.dot_general(
+        ds.astype(q.dtype), q, (((1,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+    dq_ref[...] = jax.lax.dot_general(
+        ds.astype(k.dtype), k, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+
 def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     q, k, v, out, lse = res
     b, t, h, d = q.shape
@@ -333,6 +383,32 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
                     axis=-1, keepdims=True)        # [bh, t, 1]
 
     nq, nk = t // block_q, t // block_k
+
+    if nq == 1 and nk == 1:
+        # whole sequence in one tile: fused one-pass backward (~4
+        # score-sized fp32 tiles live: s, p, dp, ds). Bigger budgets
+        # win on the isolated kernel but lose inside the full step —
+        # see the forward's budget note.
+        gf = _head_group(bh, block_q, block_k, d,
+                         tile_budget=4 * 1024 * 1024)
+        fused = functools.partial(
+            _bwd_fused_kernel, sm_scale=sm_scale, causal=causal,
+            block_q=block_q, block_k=block_k)
+        specs = pl.BlockSpec((gf, t, d), lambda i: (i, 0, 0))
+        row_spec = pl.BlockSpec((gf, t, 1), lambda i: (i, 0, 0))
+        dq, dk, dv = pl.pallas_call(
+            fused,
+            grid=(bh // gf,),
+            compiler_params=_COMPILER_PARAMS,
+            in_specs=[specs, specs, specs, specs, row_spec, row_spec],
+            out_specs=[specs, specs, specs],
+            out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                       jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                       jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+            interpret=interpret,
+        )(qt, kt, vt, dot_, lse, delta)
+        return from_bht(dq), from_bht(dk), from_bht(dv)
+
     g = _head_group(bh, block_q, block_k, d, tile_budget=2 * 1024 * 1024)
 
     dkv_kernel = functools.partial(
@@ -463,8 +539,8 @@ def _normalize_flash_args(q, k, v, causal, sm_scale, block_q, block_k,
     guarantees identical numerics)."""
     assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
     t = q.shape[1]
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
     assert t % block_q == 0 and t % block_k == 0, (
         f"seq_len {t} must divide by block sizes ({block_q}, {block_k}); "
         "pad the sequence or pass smaller block_q/block_k")

@@ -921,7 +921,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # scalar would type-promote every bf16 grad leaf to fp32 with two
         # consumers (norm + update), letting XLA materialize a full fp32
         # grad tree at peak in SR gas=1 mode
-        grad_norm = _global_norm(grads)
+        clip = self.gradient_clipping()
+        if self.fp16_mode or (clip and clip > 0):
+            grad_norm = _global_norm(grads)
+        else:
+            # nothing consumes the norm (no overflow vote off-fp16, no
+            # clip): computing it anyway costs a full extra HBM read of
+            # the grad tree (~3 GB at 1.5B) purely for logging
+            grad_norm = jnp.float32(0.0)
         if local_axis is not None:
             w = self.mesh.shape[local_axis]
             grad_norm = jnp.sqrt(
@@ -931,7 +938,6 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         else:
             overflow = jnp.asarray(False)
 
-        clip = self.gradient_clipping()
         if clip and clip > 0:
             factor = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
             factor = jnp.where(jnp.isfinite(factor), factor, 1.0)

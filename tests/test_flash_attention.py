@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 from deepspeed_tpu.ops.transformer.flash_attention import (
-    flash_attention, flash_attention_usable)
+    dense_attention, flash_attention, flash_attention_usable)
 from deepspeed_tpu.models.gpt2 import causal_attention_xla
 
 
@@ -77,3 +77,45 @@ def test_jit_and_dtype_preserved():
     out = jax.jit(lambda a, b, c: flash_attention(a, b, c))(q, k, v)
     assert out.dtype == jnp.bfloat16
     assert out.shape == q.shape
+
+
+def test_fused_single_tile_backward_parity():
+    """Default blocks at T <= _DEFAULT_BLOCK route the backward through
+    the fused one-pass kernel (nq == nk == 1) — pin its gradient parity
+    against the dense reference (review r4: the path was untested)."""
+    B, T, H, D = 2, 256, 4, 64
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, T, H, D)) * 0.3, jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((B, T, H, D)) * 0.3, jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((B, T, H, D)) * 0.3, jnp.bfloat16)
+    for causal in (True, False):
+        # no explicit blocks: min(_DEFAULT_BLOCK, T) == T == one tile
+        gf = jax.grad(lambda q: flash_attention(
+            q, k, v, causal=causal).astype(jnp.float32).sum())(q)
+        gd = jax.grad(lambda q: dense_attention(
+            q, k, v, causal=causal).astype(jnp.float32).sum())(q)
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gd, np.float32),
+            atol=0.02, rtol=0.05)
+
+
+def test_block_fit_fallback_lengths():
+    """T divisible by 512 but not 1024 (1536, 2560) must still ride the
+    kernel via the power-of-two block shrink, not fall back to dense or
+    assert (review r4)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        flash_attention_usable, _fit_block)
+    assert _fit_block(1024, 1536) == 512
+    assert _fit_block(1024, 2560) == 512
+    assert _fit_block(1024, 384) == 384   # clamp: 384 divides itself
+    B, H, D = 1, 2, 64
+    for T in (1536, 2560):
+        q = jnp.asarray(np.zeros((B, T, H, D)), jnp.bfloat16)
+        assert flash_attention_usable(q, no_dropout=True), T
+    out = flash_attention(
+        jnp.asarray(np.random.default_rng(0).standard_normal(
+            (1, 1536, 2, 64)) * 0.3, jnp.bfloat16),
+        jnp.asarray(np.zeros((1, 1536, 2, 64)), jnp.bfloat16),
+        jnp.asarray(np.zeros((1, 1536, 2, 64)), jnp.bfloat16),
+        causal=True)
+    assert out.shape == (1, 1536, 2, 64)
