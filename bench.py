@@ -64,7 +64,7 @@ def _sync(x):
 
 
 def _run_engine(model, params_box, ds_config, make_batch, steps, warmup,
-                windows=2):
+                windows=3):
     """params_box: single-element list; popped so NO reference to the
     caller's param tree survives engine init (the engine copies it, and
     a dead 3.1 GB duplicate at 1.5B is the difference between fitting
