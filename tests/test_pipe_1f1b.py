@@ -371,3 +371,38 @@ def test_1f1b_flat_checkpoint_roundtrip(tmp_path):
     got_seq = float(jax.device_get(
         e3.train_batch(batch=full_batch(4, seed=9))))
     np.testing.assert_allclose(got_seq, ref_next, rtol=5e-3)
+
+
+def test_1f1b_flat_with_bf16_sr_mode():
+    """bf16 master-less (stochastic rounding) on TOP of the per-stage
+    flat layout: moments live as bf16 flat buffers sharded over pipe,
+    tied leaves stay consistent across shards, loss descends."""
+    engine = make_engine(num_stages=2, pipe=2, data=4, gas=4,
+                         layer_dtype=jnp.bfloat16,
+                         **{"bf16": {"enabled": True,
+                                     "master_weights": False}})
+    assert engine.bf16_sr_mode and engine._pipe_flat_mode
+    assert engine.state.master is None
+
+    def find_mu(st):
+        if hasattr(st, "mu"):
+            return st.mu
+        if hasattr(st, "inner_state"):
+            return find_mu(st.inner_state)
+        if isinstance(st, (tuple, list)):
+            for item in st:
+                got = find_mu(item)
+                if got is not None:
+                    return got
+        return None
+
+    mu = find_mu(engine.state.opt_state)
+    for dt, buf in mu["flat"].items():
+        assert buf.dtype == jnp.bfloat16, (dt, buf.dtype)
+        for shard in buf.addressable_shards:
+            assert shard.data.shape == (1, buf.shape[1])
+
+    losses = [float(jax.device_get(
+        engine.train_batch(batch=full_batch(4, seed=i % 3))))
+        for i in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses
