@@ -37,7 +37,13 @@ from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF,
 
 def _block_attn_partial(q, k, v, sm_scale, mask=None):
     """Unmerged attention partial of one KV block: returns (numerator
-    [B,Tq,H,D], m [B,H,Tq,1], l [B,H,Tq,1]) for online-softmax merging."""
+    [B,Tq,H,D], m [B,H,Tq,1], l [B,H,Tq,1]) for online-softmax merging.
+
+    XLA path (scores materialize per ring step). Known follow-up: the
+    Pallas flash kernel already returns (out, lse), and two (out, lse)
+    partials merge exactly via m = max(lse1, lse2), w_i = exp2(lse_i -
+    m) — swapping it in would give each ring step flash-kernel
+    throughput at long local T without changing the ring protocol."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
