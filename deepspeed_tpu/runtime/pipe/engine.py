@@ -44,7 +44,7 @@ import inspect
 import jax
 import numpy as np
 
-from deepspeed_tpu.runtime.engine import DeepSpeedEngine, _fetch_to_host
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
 from deepspeed_tpu.runtime.pipe.topology import PipelineParallelGrid
@@ -86,10 +86,18 @@ class PipelineEngine(DeepSpeedEngine):
                 "parallelism.")  # parity: ref pipe/engine.py:57
         if self._is_pipe_module and self.pld_enabled():
             from deepspeed_tpu.utils.logging import logger
-            logger.warning(
-                "progressive_layer_drop has no effect on PipelineModule "
-                "engines (neither the sequential chain nor the 1F1B "
-                "executor plumbs layer_keep_prob)")
+            if getattr(self, "_pipe_flat_mode", False):
+                logger.warning(
+                    "progressive_layer_drop has no effect under the "
+                    "compiled 1F1B executor: stochastic depth makes the "
+                    "per-stage clock tables data-dependent (documented "
+                    "exclusion, docs/tutorials/progressive-layer-drop.md)"
+                )
+            elif not getattr(self, "_pld_accepting_layers", None):
+                logger.warning(
+                    "progressive_layer_drop is enabled but no pipeline "
+                    "layer accepts a layer_keep_prob kwarg — theta(t) "
+                    "will be computed but unused")
 
         mode = ("spmd" if self._pipelined_protocol else
                 "1f1b" if getattr(self, "_use_1f1b", False) else
@@ -114,11 +122,27 @@ class PipelineEngine(DeepSpeedEngine):
             # active exactly when the compiled 1F1B interpreter will run.
             # Parameters/grads/optimizer state then divide by the stage
             # count (ref module.py:197-249 builds only local layers per
-            # process); ZeRO param sharding (stage 3) is capped at 2 —
-            # the pipe axis already partitions the parameters.
+            # process) — and by the model axis on top (the storage
+            # composition of the reference's pipe×model grid, ref
+            # topology.py:246-249); ZeRO param sharding (stage 3) is
+            # capped at 2 — the pipe axis already partitions the
+            # parameters.
+            if self.mesh.shape[PIPE_AXIS] > 1 and \
+                    self.gradient_accumulation_steps() == 1:
+                # A 1-microbatch "pipeline" has no overlap and no 1F1B
+                # memory partitioning — every pipe device would hold the
+                # full model and idle (S-1)/S of the time. Refuse
+                # loudly rather than degrade silently (VERDICT r4 #5).
+                raise ValueError(
+                    f"pipe={self.mesh.shape[PIPE_AXIS]} requires "
+                    "gradient_accumulation_steps > 1: pipeline "
+                    "parallelism overlaps MICROBATCHES across stages "
+                    "(ref pipe/engine.py:59 train_batch consumes "
+                    "micro_batches per step). Set "
+                    '"gradient_accumulation_steps" >= the stage count '
+                    "(2x stages recommended) in the config")
             self._pipe_flat_mode = (
                 self.mesh.shape[PIPE_AXIS] > 1 and
-                self.mesh.shape[MODEL_AXIS] == 1 and
                 self.gradient_accumulation_steps() > 1)
             if self._pipe_flat_mode:
                 assert model.num_stages == self.mesh.shape[PIPE_AXIS], (
@@ -129,7 +153,12 @@ class PipelineEngine(DeepSpeedEngine):
                 from jax.sharding import PartitionSpec
                 from deepspeed_tpu.runtime.pipe.flat_params import \
                     StageFlatLayout
-                self._pipe_layout = StageFlatLayout(model, model_parameters)
+                # align so [S, F] divides over model (interp in_specs)
+                # and the composed (model, data) master sharding
+                self._pipe_layout = StageFlatLayout(
+                    model, model_parameters,
+                    align=self.mesh.shape[MODEL_AXIS] *
+                    self.mesh.shape[DATA_AXIS])
                 model_parameters = self._pipe_layout.flatten(
                     model_parameters)
                 self._zero_stage_cap = 2
@@ -138,7 +167,7 @@ class PipelineEngine(DeepSpeedEngine):
                     flat, td = jax.tree_util.tree_flatten_with_path(
                         params_f32)
                     specs = [
-                        PartitionSpec(PIPE_AXIS, None)
+                        PartitionSpec(PIPE_AXIS, MODEL_AXIS)
                         if jax.tree_util.keystr(path).startswith("['flat']")
                         else PartitionSpec()
                         for path, _ in flat]
@@ -146,8 +175,11 @@ class PipelineEngine(DeepSpeedEngine):
 
                 self._param_specs_override = _pipe_specs
 
+            kp_accepting = _layers_accepting(model, "layer_keep_prob")
+            self._pld_accepting_layers = kp_accepting
+
             def chained_loss(params, batch, rngs=None, deterministic=False,
-                             **_):
+                             layer_keep_prob=None, **_):
                 if getattr(self, "_pipe_flat_mode", False) and \
                         isinstance(params, dict) and "flat" in params:
                     params = self._pipe_layout.unflatten(params)
@@ -157,6 +189,12 @@ class PipelineEngine(DeepSpeedEngine):
                     kw = {}
                     if idx in det_accepting:
                         kw["deterministic"] = deterministic
+                    if idx in kp_accepting and layer_keep_prob is not None:
+                        # PLD θ(t): forwarded exactly as the base engine
+                        # forwards it to monolithic models (ref
+                        # engine.py:809-810 inherits through the pipe
+                        # engine's forward)
+                        kw["layer_keep_prob"] = layer_keep_prob
                     x = model.apply_layer(
                         idx, model.layer_params(params, idx), x, rngs=rngs,
                         **kw)
@@ -397,9 +435,6 @@ class PipelineEngine(DeepSpeedEngine):
             return self._pipe_layout.num_params(tree)
         return super()._count_model_params(tree)
 
-    def module_state_dict(self):
-        return _fetch_to_host(self.fp32_params)
-
     def forward(self, *args, **kwargs):
         raise RuntimeError(
             "Only train_batch() / eval_batch() are accessible on the "
@@ -422,18 +457,22 @@ class PipelineEngine(DeepSpeedEngine):
                              stage_id=self.stage_id)
 
 
-def _layers_accepting_deterministic(model):
-    """Indices of layers whose __call__ takes a `deterministic` kwarg."""
+def _layers_accepting(model, kwarg):
+    """Indices of layers whose __call__ takes the given kwarg."""
     accepting = set()
     for idx, layer in enumerate(model.layers):
         target = getattr(type(layer), "__call__", None) \
             if hasattr(layer, "apply") else layer
         try:
-            if "deterministic" in inspect.signature(target).parameters:
+            if kwarg in inspect.signature(target).parameters:
                 accepting.add(idx)
         except (TypeError, ValueError):
             pass
     return accepting
+
+
+def _layers_accepting_deterministic(model):
+    return _layers_accepting(model, "deterministic")
 
 
 def _split_batch(batch):

@@ -43,7 +43,11 @@ class StageFlatLayout:
     work identically on host numpy and inside jit.
     """
 
-    def __init__(self, module, params_example):
+    def __init__(self, module, params_example, align=1):
+        """align: round the per-dtype buffer width F up to a multiple —
+        the engine passes model*data so the [S, F] buffers divide evenly
+        over the model axis (interp in_specs) and the composed
+        (model, data) master sharding (zero/partition.py)."""
         self.S = module.num_stages
         parts = module.parts
         self._stage_treedefs = []
@@ -66,8 +70,9 @@ class StageFlatLayout:
             self._stage_meta.append(meta)
             for dt, end in offsets.items():
                 sizes.setdefault(dt, [0] * self.S)[s] = end
-        # padded width per dtype buffer = widest stage
-        self.F = {dt: max(per_stage) for dt, per_stage in sizes.items()}
+        # padded width per dtype buffer = widest stage, rounded to align
+        self.F = {dt: -(-max(per_stage) // align) * align
+                  for dt, per_stage in sizes.items()}
 
     def num_params(self, stored):
         """True parameter count (per-stage padding excluded)."""

@@ -38,6 +38,17 @@ local layers per process, ref `module.py:197-249`); tied leaves stay
 replicated with psum'd grads. Together with the schedule's
 `num_pipe_buffers()` activation bound, pipe>1 divides both parameter
 and activation memory by the stage count.
+
+MODEL-AXIS COMPOSITION: with model>1 the [S, F] buffers shard over the
+model axis too (each (pipe, model) shard stores F/model of its stage,
+masters/moments compose (model, data) on top), the stage compute
+all-gathers its stage over the model axis per tick and keeps only its
+own grad segment — parameter/optimizer memory divides by pipe*model
+(*data for masters), the storage composition of the reference's
+pipe×model grid (`topology.py:246-249`). The gather is the ZeRO-3
+pattern riding the shortest ICI hops (model is the innermost mesh
+axis); split-matmul tensor parallelism inside a stage needs TP-aware
+layers, which the homogeneous stacked-stage SPMD protocol provides.
 """
 
 import functools
@@ -48,7 +59,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.runtime.mesh import (DATA_AXIS, PIPE_AXIS,
+from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
                                         stacked_batch_pspecs)
 from deepspeed_tpu.runtime.pipe.schedule import (
     TrainSchedule, ForwardPass, BackwardPass, SendActivation,
@@ -228,6 +239,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
     come back in the same layout (flat [S, F] per dtype + replicated
     tied tree). Without it, params are a replicated full tree."""
     S = mesh.shape[PIPE_AXIS]
+    M = mesh.shape[MODEL_AXIS]
     m = micro_batches
     tables = build_clock_tables(m, S, train=train)
     B = num_pipe_buffers(m, S) if train else 1
@@ -256,15 +268,49 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
         def params_of(s, carrier):
             return carrier
+
+        def local_grads(dcarrier):
+            return dcarrier
     else:
+        for dt in layout.F:
+            assert layout.F[dt] % M == 0, (
+                f"flat buffer width {layout.F[dt]} ({dt}) not divisible "
+                f"by model={M}; build StageFlatLayout with "
+                "align=model*data (the engine's setting — model alone "
+                "satisfies this assert but leaves masters unshardable "
+                "over data)")
+
         def carrier_of(params):
-            return ({dt: params["flat"][dt][0] for dt in layout.F},
+            # model>1 divides stage parameter STORAGE over the model
+            # axis (each (pipe, model) shard holds F/model of its
+            # stage); the stage compute gathers the full stage and runs
+            # replicated within each TP group — the storage composition
+            # of the reference's pipe×model grid (ref topology.py:
+            # 246-249; true split-matmul TP needs TP-aware layers, which
+            # the stacked-stage SPMD protocol provides).
+            return ({dt: jax.lax.all_gather(
+                        params["flat"][dt][0], MODEL_AXIS,
+                        axis=0, tiled=True)
+                     for dt in layout.F},
                     params.get("tied", {}))
 
         def params_of(s, carrier):
             flat_local, tied = carrier
             return {"layers": layout.unflatten_stage(s, flat_local),
                     "tied": tied}
+
+        def local_grads(dcarrier):
+            # the gathered-carrier cotangent is the FULL stage grad,
+            # identical on every model shard (replicated compute, same
+            # data shard) — each shard keeps only its own segment so the
+            # accumulated grads come back already model-partitioned
+            dflat, dtied = dcarrier
+            i = jax.lax.axis_index(MODEL_AXIS)
+            dflat = {dt: jax.lax.dynamic_slice_in_dim(
+                         dflat[dt], i * (layout.F[dt] // M),
+                         layout.F[dt] // M)
+                     for dt in layout.F}
+            return dflat, dtied
 
     # boundary avals: activation entering stage s (s >= 1); shape
     # inference runs on the logical full tree regardless of storage
@@ -415,10 +461,13 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 loss = jax.lax.pmean(loss, DATA_AXIS)
             return loss
 
-        # grads carry mirrors the backward carrier: full tree (legacy)
-        # or (local flat buffers, tied tree) under the flat layout
+        # grads carry mirrors the ACCUMULATED layout: full tree (legacy)
+        # or (model-sliced flat buffers, tied tree) under the flat
+        # layout (shapes only — the gather/slice chain is dead code XLA
+        # eliminates)
         zeros_grads = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), carrier_of(params))
+            lambda p: jnp.zeros(p.shape, jnp.float32),
+            local_grads(carrier_of(params)))
 
         def tick(carry, row):
             (act_hold, grad_hold, fwd_out, grad_out, bufs, loss_sum,
@@ -461,7 +510,7 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 dx, dparams = jax.lax.switch(
                     s, bwd_fns, params, x_saved, grad_hold,
                     stacked_batch, my_bwd, rng, loss_scale)
-                return dx, dparams
+                return dx, local_grads(dparams)
 
             def no_bwd(_):
                 return grad_out, zeros_grads
@@ -514,10 +563,13 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         params_spec = P()
         grads_out_spec = P()
     else:
-        params_spec = {"flat": {dt: P(PIPE_AXIS, None)
+        # dim 1 over the model axis (size-1 model: identical to the
+        # pipe-only spec); each (pipe, model) shard enters with its
+        # [1, F/model] slice and leaves its own grad segment
+        params_spec = {"flat": {dt: P(PIPE_AXIS, MODEL_AXIS)
                                 for dt in layout.F},
                        "tied": P()}
-        grads_out_spec = {"flat": {dt: P(PIPE_AXIS, None)
+        grads_out_spec = {"flat": {dt: P(PIPE_AXIS, MODEL_AXIS)
                                    for dt in layout.F},
                           "tied": P()}
 

@@ -40,7 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from deepspeed_tpu.runtime.mesh import DATA_AXIS
+from deepspeed_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
 
 
 def _best_shard_dim(shape, axis_size) -> Optional[int]:
@@ -91,11 +91,27 @@ class ZeroShardingPolicy:
         return path_spec
 
     def _specs(self, params, shard_over_data: bool):
+        mp_size = self.mesh.shape.get(MODEL_AXIS, 1)
+
         def one(leaf, tp_spec):
             if np.ndim(leaf) == 0:
                 return PartitionSpec()
             if shard_over_data:
-                return leaf_data_spec(leaf, self.dp_size, tp_spec)
+                spec = leaf_data_spec(leaf, self.dp_size, tp_spec)
+                if self.dp_size > 1 and not any(
+                        s == DATA_AXIS for s in spec):
+                    # No free dim: compose onto a model-sharded dim as
+                    # (model, data) — e.g. the pipeline's [S, F] flat
+                    # buffers where dim 0 is pipe and dim 1 model, so
+                    # masters/moments divide by pipe*model*data.
+                    base = list(spec)
+                    shape = np.shape(leaf)
+                    for d, s in enumerate(base):
+                        if s == MODEL_AXIS and \
+                                shape[d] % (mp_size * self.dp_size) == 0:
+                            base[d] = (MODEL_AXIS, DATA_AXIS)
+                            return PartitionSpec(*base)
+                return spec
             return self._tp_spec_for(tp_spec, leaf)
 
         if self.param_specs is None:
