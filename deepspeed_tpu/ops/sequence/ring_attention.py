@@ -39,11 +39,10 @@ def _block_attn_partial(q, k, v, sm_scale, mask=None):
     """Unmerged attention partial of one KV block: returns (numerator
     [B,Tq,H,D], m [B,H,Tq,1], l [B,H,Tq,1]) for online-softmax merging.
 
-    XLA path (scores materialize per ring step). Known follow-up: the
-    Pallas flash kernel already returns (out, lse), and two (out, lse)
-    partials merge exactly via m = max(lse1, lse2), w_i = exp2(lse_i -
-    m) — swapping it in would give each ring step flash-kernel
-    throughput at long local T without changing the ring protocol."""
+    XLA fallback path (scores materialize per ring step) — used when
+    the local chunk doesn't meet the flash kernel's tiling contract;
+    the primary path runs the Pallas flash kernel per ring step and
+    merges normalized (out, lse) partials (`_ring_local_flash`)."""
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
@@ -70,6 +69,76 @@ def _merge(acc, num, m_new, l_new):
     num_out = num_acc * bhq1_to_bqh1(a1) + num * bhq1_to_bqh1(a2)
     l_out = l_acc * a1 + l_new * a2
     return num_out, m, l_out
+
+
+def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
+                      interpret=None):
+    """Per-device ring body on the Pallas flash kernel: each ring step
+    computes a NORMALIZED (out, lse) partial of local Q vs the held KV
+    block via `flash_attention_with_lse` (exp2-space softmax inside the
+    kernel, no materialized scores), then merges partials with
+    m = max(lse1, lse2); w_i = exp2(lse_i − m). Chunk-level causality
+    picks the kernel variant per step: the diagonal chunk runs the
+    causal kernel, strictly-lower chunks the non-causal one, upper
+    chunks contribute a zero partial (lse = −inf) without touching the
+    MXU."""
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention_with_lse
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+
+    o0 = jnp.zeros((b, tl, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+
+    def partial_of(kb, vb, step_causal):
+        ob, lb = flash_attention_with_lse(
+            q, kb, vb, causal=step_causal, sm_scale=sm_scale,
+            interpret=interpret)
+        return ob.astype(jnp.float32), lb
+
+    def step(carry, step_idx):
+        o, lse, kb, vb = carry
+        src = (my_idx - step_idx) % s_size
+
+        if causal:
+            def diag(_):
+                return partial_of(kb, vb, True)
+
+            def full(_):
+                return partial_of(kb, vb, False)
+
+            def none(_):
+                return o0, lse0
+
+            branch = jnp.where(src == my_idx, 0,
+                               jnp.where(src < my_idx, 1, 2))
+            ob, lb = jax.lax.switch(branch, [diag, full, none], None)
+        else:
+            ob, lb = partial_of(kb, vb, False)
+
+        # merge normalized partials (disjoint key sets)
+        m = jnp.maximum(jnp.maximum(lse, lb), NEG_INF / 2)
+        w1 = jnp.exp2(lse - m)
+        w2 = jnp.exp2(lb - m)
+        denom = jnp.maximum(w1 + w2, 1e-30)
+
+        def bhq1_to_bqh1(x):
+            return x.transpose(0, 2, 1, 3)
+
+        o = (o * bhq1_to_bqh1(w1) + ob * bhq1_to_bqh1(w2)) / \
+            bhq1_to_bqh1(denom)
+        lse = m + jnp.log2(denom)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (o, lse, kb, vb), None
+
+    (o, _, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(s_size))
+    return o.astype(q.dtype)
 
 
 def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
@@ -119,12 +188,33 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
-                   sm_scale=None):
-    """Ring attention over [B, T, H, D] with T sharded on `axis_name`."""
+                   sm_scale=None, use_flash=None, interpret=None):
+    """Ring attention over [B, T, H, D] with T sharded on `axis_name`.
+
+    use_flash=None auto-selects the per-step Pallas flash body when the
+    LOCAL chunk meets the kernel's tiling contract (chunk length a
+    multiple of 128, head dim a multiple of 64); otherwise the XLA
+    online-softmax fallback runs. interpret forwards to the kernel so
+    CPU tests exercise the same code path."""
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention_usable
+
+    s_size = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    local_example = jax.ShapeDtypeStruct((b, t // s_size, h, d), q.dtype)
+    if use_flash is None:
+        use_flash = (jax.default_backend() == "tpu" or bool(interpret)) \
+            and flash_attention_usable(local_example, True)
+    if use_flash:
+        body = functools.partial(_ring_local_flash, axis_name=axis_name,
+                                 causal=causal, sm_scale=sm_scale,
+                                 interpret=interpret)
+    else:
+        body = functools.partial(ring_attention_local, axis_name=axis_name,
+                                 causal=causal, sm_scale=sm_scale)
     spec = PartitionSpec(None, axis_name, None, None)
     fn = shard_map(
-        functools.partial(ring_attention_local, axis_name=axis_name,
-                          causal=causal, sm_scale=sm_scale),
+        body,
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

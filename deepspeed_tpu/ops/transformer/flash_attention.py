@@ -99,8 +99,12 @@ def flash_attention_usable(q, no_dropout: bool,
     t, d = q.shape[1], q.shape[3]
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, t)
+    # t % 128 guards the lane dimension: _fit_block clamps the block to
+    # t for 128 <= t < 1024, so without it a T like 136 would "fit" its
+    # own single tile — unaligned lanes Mosaic rejects or pads on real
+    # TPU (CPU interpret mode hides it).
     return t % block_q == 0 and t % block_k == 0 and d % 64 == 0 and \
-        t >= 128
+        t >= 128 and t % 128 == 0
 
 
 def _mask_causal(s, causal, qi, ki, block_q, block_k):
@@ -365,7 +369,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g,
+         dlse=None):
+    """dlse: optional [bh, t, 1] cotangent of the (log2-space) LSE
+    output. ∂lse/∂s_scaled = p·log2e, so the lse path contributes
+    ds += p·log2e·dlse — algebraically a shift of δ:
+    ds = p·(dp − (δ − log2e·dlse))·scale. The kernels stay unchanged;
+    only the δ row vector moves."""
     q, k, v, out, lse = res
     b, t, h, d = q.shape
     bh = b * h
@@ -381,6 +391,8 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
     # δ = rowsum(dO ⊙ O) — computed by XLA (one fused elementwise+reduce)
     delta = jnp.sum(dot_.astype(jnp.float32) * ot.astype(jnp.float32),
                     axis=-1, keepdims=True)        # [bh, t, 1]
+    if dlse is not None:
+        delta = delta - LOG2E * dlse.astype(jnp.float32)
 
     nq, nk = t // block_q, t // block_k
 
@@ -488,6 +500,53 @@ def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ----------------------------------------------------------------------
+# (out, lse) form: differentiable partials for ring attention
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    b, t, h, d = q.shape
+    return (out.reshape(b, h, t, d).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, t, 1))
+
+
+def _flash_lse_fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret)
+    b, t, h, d = q.shape
+    out_bthd = out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return (out_bthd, lse.reshape(b, h, t, 1)), (q, k, v, out_bthd, lse)
+
+
+def _flash_lse_bwd(sm_scale, causal, block_q, block_k, interpret, res, g):
+    g_out, g_lse = g
+    b = res[0].shape[0]
+    h = res[0].shape[2]
+    t = res[0].shape[1]
+    return _bwd(sm_scale, causal, block_q, block_k, interpret, res, g_out,
+                dlse=g_lse.reshape(b * h, t, 1))
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None,
+                             block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK,
+                             interpret=None):
+    """Flash attention returning (out [B,T,H,D], lse [B,H,T,1]).
+
+    The LSE is in LOG2 space (m + log2(l) over log2e-scaled scores, the
+    kernel's native convention). Two partials over disjoint key sets
+    merge exactly as m = max(lse1, lse2); w_i = exp2(lse_i − m);
+    out = (out1·w1 + out2·w2)/(w1+w2); lse = m + log2(w1+w2) — the
+    ring-attention per-step merge (ops/sequence/ring_attention.py).
+    Fully differentiable: the lse cotangent enters the backward kernels
+    as a δ shift (see _bwd)."""
+    args = _normalize_flash_args(q, k, v, causal, sm_scale, block_q,
+                                 block_k, interpret)
+    return _flash_lse(q, k, v, *args)
 
 
 # ----------------------------------------------------------------------

@@ -70,6 +70,11 @@ def test_usability_gate():
     assert not flash_attention_usable(q, False)          # dropout active
     assert not flash_attention_usable(jnp.zeros((2, 100, 4, 64)), True)
     assert not flash_attention_usable(jnp.zeros((2, 256, 4, 48)), True)
+    # 128 <= T < 1024 but T % 128 != 0: _fit_block would clamp the tile
+    # to T itself, an unaligned lane dim Mosaic rejects on real TPU
+    # (advisor r4) — the gate must refuse it
+    assert not flash_attention_usable(jnp.zeros((2, 136, 4, 64)), True)
+    assert flash_attention_usable(jnp.zeros((2, 640, 4, 64)), True)
 
 
 def test_jit_and_dtype_preserved():
@@ -119,3 +124,59 @@ def test_block_fit_fallback_lengths():
         jnp.asarray(np.zeros((1, 1536, 2, 64)), jnp.bfloat16),
         causal=True)
     assert out.shape == (1, 1536, 2, 64)
+
+
+# ----------------------------------------------------------------------
+# (out, lse) form — the ring-attention partial (VERDICT r4 #4)
+# ----------------------------------------------------------------------
+def _lse_reference(q, k, v, causal):
+    """Dense (out, log2-space lse) reference."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        t = q.shape[1]
+        mask = np.tril(np.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse_nat = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd",
+                     jnp.exp(s - lse_nat).astype(v.dtype), v)
+    return out, lse_nat * np.log2(np.e)        # kernel lse is log2-space
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_with_lse_forward_matches_dense(causal):
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention_with_lse
+    q, k, v = qkv(1, 256, 2, 64, seed=7)
+    out, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                        block_q=128, block_k=128)
+    ref_out, ref_lse = _lse_reference(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_with_lse_grads_flow_through_lse(causal):
+    """The sharp edge: a loss consuming BOTH outputs must produce the
+    same q/k/v grads as the dense reference — the lse cotangent enters
+    the backward kernels as a delta shift (flash_attention.py _bwd)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention_with_lse
+    q, k, v = qkv(1, 256, 2, 64, seed=11)
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                            block_q=128, block_k=128)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        out, lse = _lse_reference(q, k, v, causal)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-4)

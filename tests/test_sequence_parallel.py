@@ -150,3 +150,39 @@ def test_gpt2_ring_sequence_parallel_matches():
         for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0], losses
+
+
+# ----------------------------------------------------------------------
+# per-step Pallas flash partials in the ring (VERDICT r4 #4)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_flash_path_matches_dense(seq_mesh, causal):
+    """Flash (out, lse) partials merged across ring steps (interpret
+    mode exercises the same kernel code CPU-side): local chunk 128 per
+    device, d=64 — the kernel's tiling contract."""
+    q, k, v = qkv(b=1, t=1024, h=2, d=64, seed=3)
+    ref = dense_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, seq_mesh, causal=causal,
+                         use_flash=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_ring_attention_flash_path_grads_match_dense(seq_mesh):
+    """Ring grads through the per-step flash partials: the merge
+    weights consume each step's lse, so this exercises the lse-cotangent
+    delta-shift in the flash backward."""
+    q, k, v = qkv(b=1, t=1024, h=2, d=64, seed=5)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_mesh, causal=True,
+                                      use_flash=True, interpret=True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-3, rtol=1e-3)
