@@ -617,6 +617,8 @@ def bench_offload_overlap():
     pipelined()  # warmup all programs
     sequential()
     compute_only(g_host)
+    d2h_only()
+    h2d_only()
     t_pipe = min(timeit_once(pipelined) for _ in range(3))
     t_seq = min(timeit_once(sequential) for _ in range(3))
     t_d2h = min(timeit_once(d2h_only) for _ in range(3))

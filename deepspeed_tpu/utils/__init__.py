@@ -1,4 +1,7 @@
 from deepspeed_tpu.utils.logging import logger, log_dist
+# parity: the reference exports RepeatingLoader here
+# (ref utils/__init__.py:3)
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
 from deepspeed_tpu.utils.timer import (SynchronizedWallClockTimer,
                                        ThroughputTimer)
 from deepspeed_tpu.utils.distributed import init_distributed
