@@ -22,9 +22,11 @@ communication are *compiled*:
     garbage compute, an overhead factor of 1 + (S-1)/m = 1.375x,
     matching the measured 1.44x;
     on parallel hardware both paths pay the bubble as idle stages, so
-    the gap narrows but never inverts). On a pipe=1 mesh the layer
-    chain runs sequentially inside the fused step (pure microbatching
-    semantics, no overlap to be had).
+    the gap is EXPECTED to narrow without inverting — an analytic
+    claim; no multi-chip pipe hardware exists in this environment to
+    measure it). On a pipe=1 mesh the layer chain runs sequentially
+    inside the fused step (pure microbatching semantics, no overlap to
+    be had).
   * homogeneous-stage models (the PipelinedGPT2 protocol: stacked
     [S, ...] stage params + shape-preserving stage body) execute the
     GPipe fill/steady/drain timeline inside ONE jitted step —

@@ -438,10 +438,17 @@ def test_1f1b_composes_with_model_axis_3d():
             engine.train_batch(batch=full_batch(4, seed=i))))
             for i in range(4)]
 
-    _, losses_seq = run(1, 8, 1)
+    eseq, losses_seq = run(1, 8, 1)
     e3d, losses_3d = run(2, 2, 2)
     assert e3d._use_1f1b and e3d._pipe_flat_mode
     np.testing.assert_allclose(losses_3d, losses_seq, rtol=5e-3)
+
+    # pipelined eval (InferenceSchedule dataflow) also gathers the
+    # model-sharded stage buffers correctly
+    ev = full_batch(4, seed=9)
+    np.testing.assert_allclose(
+        float(jax.device_get(e3d.eval_batch(batch=ev))),
+        float(jax.device_get(eseq.eval_batch(batch=ev))), rtol=5e-3)
 
     # compute params: each (pipe, model) shard holds [1, F/2]
     for dt, buf in e3d.state.params["flat"].items():
@@ -481,3 +488,40 @@ def test_pipe_without_microbatching_raises():
     loudly, not degrade to a silent sequential chain (VERDICT r4 #5)."""
     with pytest.raises(ValueError, match="gradient_accumulation_steps"):
         make_engine(num_stages=2, pipe=2, data=4, gas=1)
+
+
+def test_1f1b_model_axis_with_bf16_sr_mode():
+    """bf16 master-less SR on the composed pipe=2 x model=2 mesh: bf16
+    flat moment buffers shard over BOTH axes and training descends."""
+    engine = make_engine(num_stages=2, pipe=2, data=2, gas=4,
+                         layer_dtype=jnp.bfloat16,
+                         mesh={"pipe": 2, "data": 2, "model": 2},
+                         zero_optimization={"stage": 1},
+                         **{"bf16": {"enabled": True,
+                                     "master_weights": False}})
+    assert engine.bf16_sr_mode and engine._pipe_flat_mode
+
+    def find_mu(st):
+        if hasattr(st, "mu"):
+            return st.mu
+        if hasattr(st, "inner_state"):
+            return find_mu(st.inner_state)
+        if isinstance(st, (tuple, list)):
+            for item in st:
+                got = find_mu(item)
+                if got is not None:
+                    return got
+        return None
+
+    mu = find_mu(engine.state.opt_state)
+    for dt, buf in mu["flat"].items():
+        assert buf.dtype == jnp.bfloat16, (dt, buf.dtype)
+        S, F = buf.shape
+        # (pipe, (model, data)) composition: [1, F/4] per device
+        for shard in buf.addressable_shards:
+            assert shard.data.shape == (1, F // 4), shard.data.shape
+
+    losses = [float(jax.device_get(
+        engine.train_batch(batch=full_batch(4, seed=i % 3))))
+        for i in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses
