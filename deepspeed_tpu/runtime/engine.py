@@ -1384,6 +1384,26 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 f"step={self.global_steps}, skipped={self.skipped_steps}, "
                 f"lr={self.get_lr()}, mom={self.get_mom()}", ranks=[0])
 
+    def stage_batch(self, batch):
+        """Place a stacked [gas, micro_bs, ...] batch pytree on device
+        with the engine's batch sharding (dim 1 over the data axis).
+        Idempotent: leaves already staged as jax.Arrays skip the host
+        np.asarray round trip (which would drag them BACK through the
+        host link), and device_put reshards device-side — a no-op when
+        the sharding already matches. Input pipelines call this ahead
+        of time to prefetch; train_batch applies it to whatever it is
+        handed."""
+        def put_stacked(x):
+            if not isinstance(x, jax.Array):
+                x = np.asarray(x)
+            spec = [None] * np.ndim(x)
+            if np.ndim(x) > 1:
+                spec[1] = DATA_AXIS
+            return jax.device_put(
+                x, NamedSharding(self.mesh, PartitionSpec(*spec)))
+
+        return jax.tree_util.tree_map(put_stacked, batch)
+
     def train_batch(self, data_iter=None, batch=None):
         """Fast path: one fused jitted step over all grad-accum
         microbatches. Pass either an iterator yielding microbatches or a
@@ -1400,17 +1420,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 f"stacked batch leading dim {leading} != gas {gas}"
 
         self.tput_timer.start()
-
-        def put_stacked(x):
-            # [gas, micro_bs, ...]: shard the batch dim (dim 1) over data.
-            x = np.asarray(x)
-            spec = [None] * x.ndim
-            if x.ndim > 1:
-                spec[1] = DATA_AXIS
-            return jax.device_put(
-                x, NamedSharding(self.mesh, PartitionSpec(*spec)))
-
-        batch = jax.tree_util.tree_map(put_stacked, batch)
+        batch = self.stage_batch(batch)
         lr = self._next_lr()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)
