@@ -297,3 +297,37 @@ def test_bare_flax_model_eval_batch():
     loss = engine(batch)
     engine.backward(loss)
     engine.step()
+
+
+def test_checkpoint_restores_lr_scheduler_state(tmp_ckpt_dir):
+    """Scheduler state rides the checkpoint (ref
+    test_checkpointing.py:406 test_checkpoint_lr_scheduler): a fresh
+    engine resumes mid-warmup at the saved iteration, and
+    load_lr_scheduler_states=False restarts the schedule."""
+    sched = {"scheduler": {"type": "WarmupLR",
+                           "params": {"warmup_min_lr": 0.0,
+                                      "warmup_max_lr": 1e-2,
+                                      "warmup_num_steps": 20}}}
+    model = SimpleModel(hidden_dim=16)
+    cfg = ds_config(**sched)
+    engine, _, _, sch = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params, config=cfg)
+    train_steps(engine, 7)
+    saved_iter = sch.last_batch_iteration
+    saved_lr = sch.get_lr()[0]
+    assert 0 < saved_lr < 1e-2    # mid-warmup
+    engine.save_checkpoint(tmp_ckpt_dir)
+
+    model2 = SimpleModel(hidden_dim=16, seed=3)
+    engine2, _, _, sch2 = deepspeed_tpu.initialize(
+        model=model2, model_parameters=model2.params, config=cfg)
+    engine2.load_checkpoint(tmp_ckpt_dir)
+    assert sch2.last_batch_iteration == saved_iter
+    np.testing.assert_allclose(sch2.get_lr()[0], saved_lr, rtol=1e-9)
+
+    model3 = SimpleModel(hidden_dim=16, seed=4)
+    engine3, _, _, sch3 = deepspeed_tpu.initialize(
+        model=model3, model_parameters=model3.params, config=cfg)
+    engine3.load_checkpoint(tmp_ckpt_dir, load_lr_scheduler_states=False)
+    assert sch3.last_batch_iteration != saved_iter or \
+        sch3.last_batch_iteration <= 0
