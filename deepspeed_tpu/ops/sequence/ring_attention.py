@@ -201,6 +201,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
 
     s_size = mesh.shape[axis_name]
     b, t, h, d = q.shape
+    if t % s_size:
+        raise ValueError(
+            f"sequence length {t} must be divisible by the '{axis_name}' "
+            f"axis size {s_size} (pad the sequence; shard_map would "
+            "otherwise fail with an opaque sharding error)")
     local_example = jax.ShapeDtypeStruct((b, t // s_size, h, d), q.dtype)
     if use_flash is None:
         use_flash = (jax.default_backend() == "tpu" or bool(interpret)) \
@@ -230,7 +235,7 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
     s_size = jax.lax.psum(1, axis_name)
     b, tl, h, d = q.shape
     assert h % s_size == 0, \
-        f"heads {h} must divide seq-parallel degree {s_size}"
+        f"heads {h} must be divisible by seq-parallel degree {s_size}"
 
     def seq_to_head(x):
         # [B, Tl, H, D] -> [B, Tl*S, H/S, D]: trade head shards for the
@@ -258,6 +263,19 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
     sharded on `axis_name`."""
     from deepspeed_tpu.ops.transformer.flash_attention import (
         flash_attention, flash_attention_usable)
+
+    s_size = mesh.shape[axis_name]
+    b, t, h, d = q.shape
+    if t % s_size:
+        raise ValueError(
+            f"sequence length {t} must be divisible by the '{axis_name}' "
+            f"axis size {s_size} (pad the sequence)")
+    if h % s_size:
+        raise ValueError(
+            f"ulysses_attention needs heads {h} divisible by the "
+            f"'{axis_name}' axis size {s_size} (the all-to-all trades "
+            "a head shard for the sequence shard); use ring_attention "
+            "for indivisible head counts")
 
     attn_fn = None
     if use_flash is None:

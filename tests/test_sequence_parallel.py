@@ -186,3 +186,16 @@ def test_ring_attention_flash_path_grads_match_dense(seq_mesh):
     for a, b in zip(gr, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-3, rtol=1e-3)
+
+
+def test_indivisible_shapes_raise_cleanly(seq_mesh):
+    """Indivisible T (or H for Ulysses) must raise a ValueError naming
+    the problem, not an opaque shard_map sharding error."""
+    q = jnp.zeros((1, 100, 8, 32), jnp.float32)    # 100 % 8 != 0
+    with pytest.raises(ValueError, match="sequence length 100"):
+        ring_attention(q, q, q, seq_mesh, causal=True)
+    with pytest.raises(ValueError, match="sequence length 100"):
+        ulysses_attention(q, q, q, seq_mesh, causal=True, use_flash=False)
+    q = jnp.zeros((1, 128, 6, 32), jnp.float32)    # 6 heads % 8 != 0
+    with pytest.raises(ValueError, match="heads 6 divisible"):
+        ulysses_attention(q, q, q, seq_mesh, causal=True, use_flash=False)
