@@ -1,0 +1,38 @@
+"""The driver runs `python bench.py` at the end of every round and
+records its single JSON line — a bench.py regression silently costs the
+round's perf record. This smoke test runs the CPU path (flagship +
+TPU-only extras are gated on the backend) in a subprocess and checks
+the output contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    # the container's sitecustomize pins the TPU plugin at interpreter
+    # startup regardless of JAX_PLATFORMS; override via jax.config
+    # BEFORE the backend initializes (same recipe as __graft_entry__)
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; runpy.run_path("
+            f"{os.path.join(REPO, 'bench.py')!r}, run_name='__main__')")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    d = json.loads(line)
+    for key in ("metric", "value", "unit", "mfu", "vs_baseline", "extra"):
+        assert key in d, (key, line[:200])
+    assert d["value"] > 0
+    # the 13B memory plan runs on every backend
+    plan = d["extra"]["gpt2_13b_zero3_memory_plan"]
+    assert plan["params_b"] > 12 and plan["state_gb_per_device"] < 2
