@@ -525,3 +525,26 @@ def test_1f1b_model_axis_with_bf16_sr_mode():
         engine.train_batch(batch=full_batch(4, seed=i % 3))))
         for i in range(10)]
     assert losses[-1] < losses[0] * 0.8, losses
+
+
+@pytest.mark.parametrize("align", [1, 3, 8, 16])
+def test_stage_flat_layout_roundtrip_any_align(align):
+    """flatten/unflatten are exact inverses for ANY align (the engine
+    passes model*data; the padding only widens F, never moves
+    offsets), and num_params excludes the padding."""
+    from deepspeed_tpu.runtime.pipe.flat_params import StageFlatLayout
+    module = hetero_module(2)
+    rng = np.random.RandomState(7)
+    example = jnp.asarray(rng.randn(4, DIN), jnp.float32)
+    params = module.init_params(jax.random.PRNGKey(7), example)
+    layout = StageFlatLayout(module, params, align=align)
+    stored = layout.flatten(params)
+    for dt, buf in stored["flat"].items():
+        assert buf.shape[1] % align == 0, (dt, buf.shape, align)
+    back = layout.unflatten(stored)
+    for a, b in zip(jax.tree_util.tree_leaves(params["layers"]),
+                    jax.tree_util.tree_leaves(back["layers"])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    true_n = sum(int(np.prod(np.shape(l))) for l in
+                 jax.tree_util.tree_leaves(params))
+    assert layout.num_params(stored) == true_n
