@@ -163,7 +163,8 @@ def _run_engine(model, params_box, ds_config, make_batch, steps, warmup,
 
 
 def _gpt2_throughput(model_name, batch, seq, steps, warmup, ds_config,
-                     remat_policy=None, probe=False, **cfg_overrides):
+                     remat_policy=None, probe=False, windows=3,
+                     **cfg_overrides):
     import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
 
@@ -186,7 +187,8 @@ def _gpt2_throughput(model_name, batch, seq, steps, warmup, ds_config,
         return {"input_ids": ids}
 
     dt, _, probe_tf = _run_engine(model, box, ds_config, make_batch,
-                                  steps, warmup, probe=probe)
+                                  steps, warmup, probe=probe,
+                                  windows=windows)
     n_chips = len(jax.devices())
     tokens_per_sec_per_chip = batch * seq * steps / dt / n_chips
     # 6ND model flops (conservative convention; remat recompute and
@@ -214,6 +216,7 @@ def bench_gpt2_15b():
     # 16 steps halves it (real training has no such per-8-step fence)
     return _gpt2_throughput(
         "gpt2-1.5b", batch=11, seq=1024, steps=16, warmup=6, probe=True,
+        windows=4,
         ds_config={
             "train_micro_batch_size_per_gpu": 11,
             "gradient_accumulation_steps": 1,
