@@ -16,7 +16,9 @@ configuration on this hardware.
   * 16k/32k block-sparse vs dense flash (ref claims up to 6.3x)
   * a REAL ZeRO-Offload optimizer step (grads -> host CPU-Adam ->
     params), with the measured host/transfer split
-  * GPT-2 13B ZeRO-3 memory plan (eval_shape arithmetic, no step)
+  * ring-attention per-step flash partial vs the XLA fallback
+  * GPT-2 13B ZeRO-3 memory plan (eval_shape arithmetic, no step;
+    the executed 13B proof is artifacts/ARTIFACT_13B_r05.log)
   * 1F1B interpreter vs SPMD pipe ratio on the same model
 
 Measurement notes (this chip is reached through a remote-dispatch
@@ -25,8 +27,9 @@ tunnel and may be SHARED):
     slow (donated buffers settle into the step's output layouts; the
     axon path warms per-executable state), and timing them halves the
     reported number
-  * the timed section runs 2 windows and keeps the best (guards
-    against transient contention on a shared chip)
+  * the timed section runs 3-4 windows and keeps the best (guards
+    against transient contention on a shared chip); the flagship
+    interleaves a latency-cancelled matmul-peak probe between windows
   * sync via device_get (block_until_ready can return early through
     the tunnel)
 """
