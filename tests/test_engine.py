@@ -331,3 +331,35 @@ def test_checkpoint_restores_lr_scheduler_state(tmp_ckpt_dir):
     engine3.load_checkpoint(tmp_ckpt_dir, load_lr_scheduler_states=False)
     assert sch3.last_batch_iteration != saved_iter or \
         sch3.last_batch_iteration <= 0
+
+
+@pytest.mark.parametrize("stage", [1, 2])
+def test_zero_with_unused_params(stage):
+    """ZeRO with a parameter the loss never touches (ref test_zero.py:32
+    unbalanced-gradients scenario — in SPMD the analogue is a zero
+    gradient, not an absent one): the unused leaf must stay bitwise
+    unchanged under Adam (zero grad, zero moments) while training
+    descends, and its optimizer state must still shard over data."""
+    class ModelWithUnused:
+        def __init__(self, dim=16):
+            rng = np.random.RandomState(0)
+            self.params = {
+                "w": jnp.asarray(rng.randn(dim, dim) * 0.1, jnp.float32),
+                "b": jnp.zeros((dim,), jnp.float32),
+                "unused": jnp.asarray(rng.randn(dim, dim), jnp.float32),
+            }
+
+        def loss_fn(self, params, batch, rngs=None, deterministic=False):
+            pred = batch["x"].astype(jnp.float32) @ params["w"] + \
+                params["b"]
+            return jnp.mean((pred - batch["y"].astype(jnp.float32)) ** 2)
+
+    model = ModelWithUnused()
+    before = np.asarray(model.params["unused"])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config=ds_config(zero_optimization={"stage": stage}))
+    losses = train_steps(engine, 8)
+    assert losses[-1] < losses[0]
+    after = np.asarray(jax.device_get(engine.fp32_params["unused"]))
+    np.testing.assert_array_equal(before, after)
