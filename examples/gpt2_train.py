@@ -15,6 +15,11 @@ import os
 import sys
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the container pins the TPU plugin at interpreter startup; honor
+    # the env override before the backend initializes
+    jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
 # runnable from a source checkout without installation
