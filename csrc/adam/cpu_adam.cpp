@@ -44,10 +44,14 @@ std::unordered_map<int, AdamState>& registry() {
 }
 
 // Shared update loop: one fused AdamW pass over [0, n) at an explicit
-// bias-correction step.
-void adam_apply(const AdamState& st, int64_t step, int64_t n,
-                float* params, const float* grads, float* exp_avg,
-                float* exp_avg_sq, float lr_override) {
+// bias-correction step. Templated on the gradient load so the
+// compressed-wire variants (int8 x per-block scale, packed sign bits)
+// dequantize INSIDE the fused loop — no materialized fp32 grad buffer
+// on the host, and the compiler still vectorizes each instantiation.
+template <typename GradAt>
+void adam_apply_t(const AdamState& st, int64_t step, int64_t n,
+                  float* params, GradAt grad_at, float* exp_avg,
+                  float* exp_avg_sq, float lr_override) {
     // negative = no override; 0.0 is a legitimate scheduled lr
     const float lr = lr_override >= 0.0f ? lr_override : st.lr;
     const float b1 = st.beta1;
@@ -63,7 +67,7 @@ void adam_apply(const AdamState& st, int64_t step, int64_t n,
 
 #pragma omp parallel for schedule(static)
     for (int64_t i = 0; i < n; ++i) {
-        float g = grads[i];
+        float g = grad_at(i);
         float p = params[i];
         if (!adamw && wd != 0.0f) g += wd * p;  // L2 (classic Adam)
         float m = b1 * exp_avg[i] + (1.0f - b1) * g;
@@ -76,6 +80,14 @@ void adam_apply(const AdamState& st, int64_t step, int64_t n,
         float decay = (adamw && wd != 0.0f) ? lr * wd * p : 0.0f;
         params[i] = p - step_size * (m / denom) - decay;
     }
+}
+
+void adam_apply(const AdamState& st, int64_t step, int64_t n,
+                float* params, const float* grads, float* exp_avg,
+                float* exp_avg_sq, float lr_override) {
+    adam_apply_t(st, step, n, params,
+                 [grads](int64_t i) { return grads[i]; },
+                 exp_avg, exp_avg_sq, lr_override);
 }
 
 void bf16_cast(const float* params, uint16_t* params_bf16, int64_t n) {
@@ -142,6 +154,50 @@ int64_t ds_adam_step_chunk(int optimizer_id, int64_t step, int64_t n,
     if (it == registry().end()) return -1;
     adam_apply(it->second, step, n, params, grads, exp_avg, exp_avg_sq,
                lr_override);
+    if (params_bf16 != nullptr) bf16_cast(params, params_bf16, n);
+    return step;
+}
+
+// Compressed-wire chunk steps (ZeRO-Offload offload_wire): gradients
+// arrive quantized and are dequantized INSIDE the fused AdamW loop.
+// Layout contract (runtime/zero/offload.py): chunk starts on a
+// quantization-block boundary, scales[i / block] covers element i.
+
+// int8 grads with one fp32 scale per `block` elements.
+int64_t ds_adam_step_chunk_q8(int optimizer_id, int64_t step, int64_t n,
+                              float* params, const int8_t* qgrads,
+                              const float* scales, int64_t block,
+                              float* exp_avg, float* exp_avg_sq,
+                              uint16_t* params_bf16 /* may be null */,
+                              float lr_override) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    adam_apply_t(it->second, step, n, params,
+                 [qgrads, scales, block](int64_t i) {
+                     return (float)qgrads[i] * scales[i / block];
+                 },
+                 exp_avg, exp_avg_sq, lr_override);
+    if (params_bf16 != nullptr) bf16_cast(params, params_bf16, n);
+    return step;
+}
+
+// 1-bit grads: sign bits packed LSB-first 8-to-a-byte (the pack_signs
+// layout of runtime/fp16/onebit_adam.py) with one fp32 scale per
+// `block` elements; g = ±scale.
+int64_t ds_adam_step_chunk_q1(int optimizer_id, int64_t step, int64_t n,
+                              float* params, const uint8_t* packed,
+                              const float* scales, int64_t block,
+                              float* exp_avg, float* exp_avg_sq,
+                              uint16_t* params_bf16 /* may be null */,
+                              float lr_override) {
+    auto it = registry().find(optimizer_id);
+    if (it == registry().end()) return -1;
+    adam_apply_t(it->second, step, n, params,
+                 [packed, scales, block](int64_t i) {
+                     float s = scales[i / block];
+                     return ((packed[i >> 3] >> (i & 7)) & 1) ? s : -s;
+                 },
+                 exp_avg, exp_avg_sq, lr_override);
     if (params_bf16 != nullptr) bf16_cast(params, params_bf16, n);
     return step;
 }

@@ -159,6 +159,76 @@ class DeepSpeedCPUAdam:
             params_bf16_out[:] = np.asarray(bf).view(np.uint16)
         return params
 
+    def step_chunk_q8(self, lo, hi, params, qgrads, scales, block,
+                      lr=None, params_bf16_out=None):
+        """step_chunk with int8 gradients + one fp32 scale per `block`
+        elements (ZeRO-Offload compressed wire). The chunk must start on
+        a block boundary; scales[i // block] covers chunk element i.
+        Native path dequantizes inside the fused AdamW loop."""
+        import ctypes
+        assert self.step_count >= 1, "step_chunk_q8 requires begin_step()"
+        assert params.dtype == np.float32 and qgrads.dtype == np.int8
+        assert scales.dtype == np.float32
+        assert params.size == hi - lo == qgrads.size
+        assert scales.size * block >= hi - lo
+        if self._lib is not None:
+            f32p = ctypes.POINTER(ctypes.c_float)
+            i8p = ctypes.POINTER(ctypes.c_int8)
+            u16p = ctypes.POINTER(ctypes.c_uint16)
+            bf16 = params_bf16_out.ctypes.data_as(u16p) \
+                if params_bf16_out is not None else \
+                ctypes.cast(None, u16p)
+            m = self.exp_avg[lo:hi]
+            v = self.exp_avg_sq[lo:hi]
+            self._lib.ds_adam_step_chunk_q8(
+                self.opt_id, self.step_count, hi - lo,
+                params.ctypes.data_as(f32p),
+                np.ascontiguousarray(qgrads).ctypes.data_as(i8p),
+                np.ascontiguousarray(scales).ctypes.data_as(f32p),
+                block, m.ctypes.data_as(f32p), v.ctypes.data_as(f32p),
+                bf16, -1.0 if lr is None else float(lr))
+            return params
+        # numpy fallback: dequantize, then the shared chunk math
+        g = qgrads.astype(np.float32) * \
+            np.repeat(scales, block)[: hi - lo]
+        return self.step_chunk(lo, hi, params, g, lr=lr,
+                               params_bf16_out=params_bf16_out)
+
+    def step_chunk_q1(self, lo, hi, params, packed, scales, block,
+                      lr=None, params_bf16_out=None):
+        """step_chunk with 1-bit gradients: sign bits packed LSB-first
+        8-per-byte (`pack_signs` layout, runtime/fp16/onebit_adam.py)
+        with one fp32 scale per `block` elements; g = ±scale."""
+        import ctypes
+        assert self.step_count >= 1, "step_chunk_q1 requires begin_step()"
+        assert params.dtype == np.float32 and packed.dtype == np.uint8
+        assert scales.dtype == np.float32
+        n = hi - lo
+        assert params.size == n and packed.size >= -(-n // 8)
+        assert scales.size * block >= n
+        if self._lib is not None:
+            f32p = ctypes.POINTER(ctypes.c_float)
+            u8p = ctypes.POINTER(ctypes.c_uint8)
+            u16p = ctypes.POINTER(ctypes.c_uint16)
+            bf16 = params_bf16_out.ctypes.data_as(u16p) \
+                if params_bf16_out is not None else \
+                ctypes.cast(None, u16p)
+            m = self.exp_avg[lo:hi]
+            v = self.exp_avg_sq[lo:hi]
+            self._lib.ds_adam_step_chunk_q1(
+                self.opt_id, self.step_count, n,
+                params.ctypes.data_as(f32p),
+                np.ascontiguousarray(packed).ctypes.data_as(u8p),
+                np.ascontiguousarray(scales).ctypes.data_as(f32p),
+                block, m.ctypes.data_as(f32p), v.ctypes.data_as(f32p),
+                bf16, -1.0 if lr is None else float(lr))
+            return params
+        bits = np.unpackbits(packed, bitorder="little")[:n]
+        g = np.where(bits > 0, 1.0, -1.0).astype(np.float32) * \
+            np.repeat(scales, block)[:n]
+        return self.step_chunk(lo, hi, params, g, lr=lr,
+                               params_bf16_out=params_bf16_out)
+
     def state_dict(self):
         return {"exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq,
                 "step": self.step_count}

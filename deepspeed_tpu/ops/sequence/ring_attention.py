@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
-from jax import shard_map
+from deepspeed_tpu.runtime.compat import shard_map
 
 from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF,
                                                            dense_attention)
@@ -187,15 +187,31 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
     return out.astype(q.dtype)
 
 
+def _mesh_targets_tpu(mesh):
+    """Whether the MESH's devices are TPUs. The auto-selection keys on
+    this rather than jax.default_backend() so ahead-of-time lowering for
+    a TPU target from a CPU host process still picks the flash body —
+    default_backend() reports the HOST's backend at trace time, which
+    silently chose the XLA fallback under cross-backend AOT."""
+    try:
+        return mesh.devices.flat[0].platform == "tpu"
+    except Exception:   # AbstractMesh or device-less mesh variants
+        return jax.default_backend() == "tpu"
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
                    sm_scale=None, use_flash=None, interpret=None):
     """Ring attention over [B, T, H, D] with T sharded on `axis_name`.
 
     use_flash=None auto-selects the per-step Pallas flash body when the
-    LOCAL chunk meets the kernel's tiling contract (chunk length a
-    multiple of 128, head dim a multiple of 64); otherwise the XLA
-    online-softmax fallback runs. interpret forwards to the kernel so
-    CPU tests exercise the same code path."""
+    mesh's devices are TPUs (keyed on the MESH target, not
+    jax.default_backend(), so cross-backend AOT lowering selects
+    correctly — pass use_flash explicitly to override) and the LOCAL
+    chunk meets the kernel's tiling contract (chunk length a multiple of
+    128, head dim a multiple of 64); otherwise the XLA online-softmax
+    fallback runs. interpret forwards to the kernel so CPU tests
+    exercise the same code path. (Same selection applies to
+    `ulysses_attention`.)"""
     from deepspeed_tpu.ops.transformer.flash_attention import \
         flash_attention_usable
 
@@ -208,7 +224,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
             "otherwise fail with an opaque sharding error)")
     local_example = jax.ShapeDtypeStruct((b, t // s_size, h, d), q.dtype)
     if use_flash is None:
-        use_flash = (jax.default_backend() == "tpu" or bool(interpret)) \
+        use_flash = (_mesh_targets_tpu(mesh) or bool(interpret)) \
             and flash_attention_usable(local_example, True)
     if use_flash:
         body = functools.partial(_ring_local_flash, axis_name=axis_name,
@@ -279,7 +295,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
 
     attn_fn = None
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        # keyed on the mesh target, not default_backend() — see
+        # _mesh_targets_tpu (cross-backend AOT lowering)
+        use_flash = _mesh_targets_tpu(mesh)
     if use_flash:
         def attn_fn(qg, kg, vg):
             if flash_attention_usable(qg, True):

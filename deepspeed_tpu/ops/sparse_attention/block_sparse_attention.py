@@ -39,14 +39,28 @@ _VMEM_LIMIT = 64 * 1024 * 1024
 _FWD_MIN_OUTER = 8
 
 
+def _element_spec(shape, index_map):
+    """All-Element BlockSpec (every index_map coordinate is an ELEMENT
+    offset). Spelled `pl.Element` per dim on modern pallas; older
+    releases (jax 0.4.x) express the same thing as a whole-spec
+    Unblocked indexing mode."""
+    if hasattr(pl, "Element"):
+        return pl.BlockSpec(tuple(pl.Element(s) for s in shape),
+                            index_map)
+    return pl.BlockSpec(tuple(shape), index_map,
+                        indexing_mode=pl.Unblocked())
+
+
 def _compiler_params(kind):
     # Measured on v5e at the 16k bench point: the BACKWARD kernels want
     # ("parallel","parallel","arbitrary") (+40% over default), while
     # the forward's online-softmax carry pipelines better with Mosaic's
     # own scheduling (declared semantics cost it ~25%).
     sem = ("parallel", "parallel", "arbitrary") if kind == "bwd" else None
-    return pltpu.CompilerParams(
-        dimension_semantics=sem, vmem_limit_bytes=_VMEM_LIMIT)
+    # CompilerParams was TPUCompilerParams before jax 0.6 (same fields)
+    cls = getattr(pltpu, "CompilerParams",
+                  getattr(pltpu, "TPUCompilerParams", None))
+    return cls(dimension_semantics=sem, vmem_limit_bytes=_VMEM_LIMIT)
 
 
 # ----------------------------------------------------------------------
@@ -752,10 +766,8 @@ def _band_fwd(q, k, v, band, sm_scale, causal, block, interpret, qt,
         grid=(bh // g, nqs, n_steps),
         in_specs=[
             pl.BlockSpec((g, qtb, d), lambda grp, R, st: (grp, R, 0)),
-            pl.BlockSpec((pl.Element(g), pl.Element(BW * block),
-                          pl.Element(d)), band_idx),
-            pl.BlockSpec((pl.Element(g), pl.Element(BW * block),
-                          pl.Element(d)), band_idx),
+            _element_spec((g, BW * block, d), band_idx),
+            _element_spec((g, BW * block, d), band_idx),
             pl.BlockSpec((g, tk, d), gtile_idx),
             pl.BlockSpec((g, tk, d), gtile_idx),
             pl.BlockSpec((1, tk), lambda grp, R, st: (0, gtile(R, st))),

@@ -47,7 +47,10 @@ _DEFAULT_BLOCK = 1024
 # scoped-vmem ceiling to make the fatter tiles legal).
 _DEFAULT_HEAD_GROUP = 8
 _VMEM_LIMIT = 100 * 1024 * 1024
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
+# CompilerParams was TPUCompilerParams before jax 0.6 (same fields)
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+_COMPILER_PARAMS = _CompilerParams(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _on_tpu():

@@ -1,0 +1,24 @@
+"""Version compatibility shims for the jax API surface.
+
+The engine targets the modern top-level `jax.shard_map` (check_vma
+keyword); older jaxlib images (e.g. 0.4.x) ship it as
+`jax.experimental.shard_map.shard_map` with the keyword spelled
+`check_rep`. Import `shard_map` from here instead of from jax so both
+work — the call sites keep the modern `check_vma` spelling.
+"""
+
+import functools
+
+try:
+    from jax import shard_map as _shard_map
+    _REPLICATION_KW = "check_vma"
+except ImportError:  # jax < 0.6: experimental module, check_rep kw
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _REPLICATION_KW = "check_rep"
+
+
+@functools.wraps(_shard_map)
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=True):
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      **{_REPLICATION_KW: check_vma})

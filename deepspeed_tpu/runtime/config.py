@@ -514,6 +514,12 @@ class DeepSpeedConfig:
             assert self.zero_optimization_stage <= MAX_STAGE_ZERO_OPTIMIZATION
 
     def _do_warning_check(self):
+        if self.zero_config.offload_wire_compressed() and \
+                not self.zero_config.cpu_offload:
+            logger.warning(
+                "DeepSpeedConfig: zero_optimization.offload_wire "
+                "compresses the ZeRO-Offload host link and has no effect "
+                "without cpu_offload: true")
         fp16_enabled = self.fp16_enabled or self.zero_enabled
         vocabulary_size = self._param_dict.get("vocabulary_size", None)
         if vocabulary_size and vocabulary_size % 8 != 0:

@@ -208,3 +208,29 @@ MESH = "mesh"
 MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"
 MESH_PIPE_AXIS = "pipe"
+
+#############################################
+# ZeRO-Offload compressed wire (TPU-native extension): the host link is
+# the bottleneck of the offload round trip, so the wire format is
+# configurable under zero_optimization.offload_wire:
+#   {"offload_wire": {"grad_bits": 8, "param_bits": 8, "warmup_steps": 0}}
+# grad_bits (D2H gradients): 32 = native wire, exactly the legacy
+#   behavior (bf16 when computing in bf16, fp32 otherwise); 16 = force
+#   bf16; 8 = int8 with a per-block fp32 scale; 1 = sign bits + one
+#   per-block scale with on-device error feedback (1-bit Adam's
+#   compression, runtime/fp16/onebit_adam.py).
+# param_bits (H2D updated params): 32 = native (legacy); 8 = int8
+#   param-delta against a device-resident fp32 param copy, with
+#   host-side error feedback via a shadow copy.
+# warmup_steps: steps that run a full-precision fp32 wire before
+#   compression engages (error feedback starts from a settled state).
+#############################################
+OFFLOAD_WIRE = "offload_wire"
+OFFLOAD_WIRE_GRAD_BITS = "grad_bits"
+OFFLOAD_WIRE_GRAD_BITS_DEFAULT = 32
+OFFLOAD_WIRE_PARAM_BITS = "param_bits"
+OFFLOAD_WIRE_PARAM_BITS_DEFAULT = 32
+OFFLOAD_WIRE_WARMUP_STEPS = "warmup_steps"
+OFFLOAD_WIRE_WARMUP_STEPS_DEFAULT = 0
+OFFLOAD_WIRE_GRAD_BITS_VALID = (1, 8, 16, 32)
+OFFLOAD_WIRE_PARAM_BITS_VALID = (8, 32)

@@ -310,6 +310,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def zero_cpu_offload(self):
         return self._config.zero_config.cpu_offload
 
+    def zero_offload_wire(self):
+        """The zero_optimization.offload_wire block (compressed offload
+        wire format; runtime/zero/offload.py)."""
+        zc = self._config.zero_config
+        return dict(grad_bits=zc.offload_wire_grad_bits,
+                    param_bits=zc.offload_wire_param_bits,
+                    warmup_steps=zc.offload_wire_warmup_steps)
+
     def zero_reduce_bucket_size(self):
         return self._config.zero_config.reduce_bucket_size
 
@@ -851,7 +859,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         Only used at ZeRO stage 0 (params replicated), matching the
         reference, whose CSR path lives in the non-ZeRO fallback
         (`engine.py:836,1160`)."""
-        from jax import shard_map
+        from deepspeed_tpu.runtime.compat import shard_map
         from deepspeed_tpu.runtime.csr_tensor import csr_mean_rows
 
         sparse_paths = self._sparse_grad_paths()
@@ -1159,7 +1167,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         Params/opt-state are replicated in and provably identical out:
         every shard decodes the same gathered signs, so the update is
         deterministic across workers."""
-        from jax import shard_map
+        from deepspeed_tpu.runtime.compat import shard_map
         from deepspeed_tpu.runtime.fp16.onebit_adam import onebit_adam
 
         transform = onebit_adam(**self._onebit_kwargs,
@@ -1546,7 +1554,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     @property
     def fp32_params(self):
         if self._offload_enabled():
-            return self._offload_unravel(jnp.asarray(self._host_master))
+            # copy=True: on the CPU backend jnp.asarray may ALIAS the
+            # numpy buffer, and _host_master is updated in place by
+            # every subsequent optimizer step — a caller holding this
+            # tree would silently see it mutate
+            return self._offload_unravel(
+                jnp.array(self._host_master, copy=True))
         if self.mixed_precision:
             return self.zero_policy.decode(self.state.master,
                                            self._zero_pad_plan)
@@ -1600,6 +1613,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self._offload_enabled():
             optim_sd["host_adam"] = self._host_adam.state_dict()
             optim_sd["host_master"] = self._host_master
+            if self._config.zero_config.offload_wire_compressed():
+                optim_sd["offload_wire"] = \
+                    self._offload_wire_state_dict()
         save_checkpoint_files(save_dir, tag, sd, optim_sd)
         if save_latest and jax.process_index() == 0:
             write_latest_tag(save_dir, tag)
@@ -1620,6 +1636,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self._offload_enabled():
             aux_templates["host_master"] = self._host_master
             aux_templates["host_adam"] = self._host_adam.state_dict()
+            if self._config.zero_config.offload_wire_compressed():
+                aux_templates["offload_wire"] = \
+                    self._offload_wire_state_dict()
         per_layer = hasattr(self.module, "save_state_dict") and \
             hasattr(self.module, "load_state_dir")
         sd, optim_sd = load_checkpoint_files(
@@ -1671,6 +1690,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             from jax.flatten_util import ravel_pytree
             flat, _ = ravel_pytree(params_f32)
             self._host_master[:] = np.asarray(jax.device_get(flat))
+            if self._config.zero_config.offload_wire_compressed():
+                # shadow/device copy resync to the restored masters; a
+                # wire state dict loaded below may overwrite this
+                self._offload_wire_load_state_dict(None)
 
         opt_state = self.state.opt_state
         scale = self.state.scale
@@ -1683,6 +1706,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     np.asarray(optim_sd["scale"][0]))
                 scale = make_static_loss_scale_state(
                     self._host_scaler.cur_scale)
+                if self._config.zero_config.offload_wire_compressed():
+                    # restores the error-feedback residual / param
+                    # shadow, or resyncs them to the loaded masters when
+                    # the checkpoint was written without wire state
+                    self._offload_wire_load_state_dict(
+                        optim_sd.get("offload_wire"))
             else:
                 # checkpoint written without offload: masters restore
                 # from the saved fp32 module weights; moments restart

@@ -13,6 +13,7 @@ Bucket-size knobs are accepted for config compatibility; XLA's collective
 scheduler replaces manual bucketing, so they act as hints only.
 """
 
+from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config_utils import get_scalar_param
 
 ZERO_OPTIMIZATION = "zero_optimization"
@@ -70,6 +71,9 @@ class DeepSpeedZeroConfig:
         self.load_from_fp32_weights = None
         self.cpu_offload = None
         self.elastic_checkpoint = None
+        self.offload_wire_grad_bits = None
+        self.offload_wire_param_bits = None
+        self.offload_wire_warmup_steps = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -114,6 +118,40 @@ class DeepSpeedZeroConfig:
         self.elastic_checkpoint = get_scalar_param(
             d, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
+        self._initialize_offload_wire(d.get(C.OFFLOAD_WIRE) or {})
+
+    def _initialize_offload_wire(self, w):
+        """zero_optimization.offload_wire: compressed wire format for the
+        ZeRO-Offload round trip (see runtime/constants.py for semantics;
+        runtime/zero/offload.py implements it). Defaults reproduce the
+        uncompressed legacy wire exactly."""
+        assert isinstance(w, dict), \
+            f"zero_optimization.{C.OFFLOAD_WIRE} must be a dict, got {w!r}"
+        self.offload_wire_grad_bits = int(get_scalar_param(
+            w, C.OFFLOAD_WIRE_GRAD_BITS, C.OFFLOAD_WIRE_GRAD_BITS_DEFAULT))
+        self.offload_wire_param_bits = int(get_scalar_param(
+            w, C.OFFLOAD_WIRE_PARAM_BITS,
+            C.OFFLOAD_WIRE_PARAM_BITS_DEFAULT))
+        self.offload_wire_warmup_steps = int(get_scalar_param(
+            w, C.OFFLOAD_WIRE_WARMUP_STEPS,
+            C.OFFLOAD_WIRE_WARMUP_STEPS_DEFAULT))
+        assert self.offload_wire_grad_bits in \
+            C.OFFLOAD_WIRE_GRAD_BITS_VALID, (
+                f"{C.OFFLOAD_WIRE}.{C.OFFLOAD_WIRE_GRAD_BITS} must be one "
+                f"of {C.OFFLOAD_WIRE_GRAD_BITS_VALID}, got "
+                f"{self.offload_wire_grad_bits}")
+        assert self.offload_wire_param_bits in \
+            C.OFFLOAD_WIRE_PARAM_BITS_VALID, (
+                f"{C.OFFLOAD_WIRE}.{C.OFFLOAD_WIRE_PARAM_BITS} must be one "
+                f"of {C.OFFLOAD_WIRE_PARAM_BITS_VALID}, got "
+                f"{self.offload_wire_param_bits}")
+        assert self.offload_wire_warmup_steps >= 0, (
+            f"{C.OFFLOAD_WIRE}.{C.OFFLOAD_WIRE_WARMUP_STEPS} must be >= 0")
+
+    def offload_wire_compressed(self):
+        """True when any leg of the wire differs from the legacy format."""
+        return (self.offload_wire_grad_bits != 32 or
+                self.offload_wire_param_bits != 32)
 
     def repr(self):
         return dict(stage=self.stage,
@@ -125,7 +163,11 @@ class DeepSpeedZeroConfig:
                     overlap_comm=self.overlap_comm,
                     load_from_fp32_weights=self.load_from_fp32_weights,
                     cpu_offload=self.cpu_offload,
-                    elastic_checkpoint=self.elastic_checkpoint)
+                    elastic_checkpoint=self.elastic_checkpoint,
+                    offload_wire=dict(
+                        grad_bits=self.offload_wire_grad_bits,
+                        param_bits=self.offload_wire_param_bits,
+                        warmup_steps=self.offload_wire_warmup_steps))
 
     def __repr__(self):
         return str(self.repr())

@@ -83,6 +83,7 @@ class ZeroShardingPolicy:
         self.stage = stage
         self.dp_size = mesh.shape[DATA_AXIS]
         self.param_specs = param_specs
+        self._warned_replicated_fallback = False
 
     # -- spec builders ----------------------------------------------------
     def _tp_spec_for(self, path_spec, leaf):
@@ -92,6 +93,7 @@ class ZeroShardingPolicy:
 
     def _specs(self, params, shard_over_data: bool):
         mp_size = self.mesh.shape.get(MODEL_AXIS, 1)
+        fallback_elems = [0]   # numel that silently stays replicated
 
         def one(leaf, tp_spec):
             if np.ndim(leaf) == 0:
@@ -111,12 +113,31 @@ class ZeroShardingPolicy:
                                 shape[d] % (mp_size * self.dp_size) == 0:
                             base[d] = (MODEL_AXIS, DATA_AXIS)
                             return PartitionSpec(*base)
+                    # still nothing took DATA_AXIS: this leaf's
+                    # masters/moments will be data-REPLICATED (the
+                    # pad-plan may re-shard it later, but e.g. a
+                    # StageFlatLayout built without align=model*data
+                    # loses the pipe*model*data memory division here)
+                    if int(np.prod(shape)) >= 2 * self.dp_size:
+                        fallback_elems[0] += int(np.prod(shape))
                 return spec
             return self._tp_spec_for(tp_spec, leaf)
 
         if self.param_specs is None:
-            return jax.tree_util.tree_map(lambda l: one(l, None), params)
-        return jax.tree_util.tree_map(one, params, self.param_specs)
+            out = jax.tree_util.tree_map(lambda l: one(l, None), params)
+        else:
+            out = jax.tree_util.tree_map(one, params, self.param_specs)
+        if fallback_elems[0] and not self._warned_replicated_fallback:
+            self._warned_replicated_fallback = True
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                f"ZeRO: {fallback_elems[0] / 1e6:.1f}M elements have no "
+                f"dimension divisible by dp={self.dp_size} and fall back "
+                "to data-REPLICATED optimizer state unless the pad-plan "
+                "re-shards them — per-device memory will not divide by "
+                "the data axis for these leaves (pad to a dp multiple, "
+                "or align flat layouts by model*data)")
+        return out
 
     # -- public: per-group PartitionSpec pytrees -------------------------
     def param_pspecs(self, params):

@@ -34,6 +34,16 @@ class CPUAdamBuilder(OpBuilder):
             ctypes.c_int, ctypes.c_int64, ctypes.c_int64, f32p, f32p,
             f32p, f32p, u16p, ctypes.c_float]
         lib.ds_adam_step_chunk.restype = ctypes.c_int64
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.ds_adam_step_chunk_q8.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64, f32p, i8p,
+            f32p, ctypes.c_int64, f32p, f32p, u16p, ctypes.c_float]
+        lib.ds_adam_step_chunk_q8.restype = ctypes.c_int64
+        lib.ds_adam_step_chunk_q1.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64, f32p, u8p,
+            f32p, ctypes.c_int64, f32p, f32p, u16p, ctypes.c_float]
+        lib.ds_adam_step_chunk_q1.restype = ctypes.c_int64
         lib.ds_adam_get_step.argtypes = [ctypes.c_int]
         lib.ds_adam_get_step.restype = ctypes.c_int
         lib.ds_adam_set_step.argtypes = [ctypes.c_int, ctypes.c_int64]

@@ -12,12 +12,26 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in _flags:
     _flags += " --xla_force_host_platform_device_count=8"
-if "collective_call_terminate_timeout" not in _flags:
+if "collective_call_terminate_timeout" not in _flags and \
+        os.environ.get("DS_TPU_RUN_13B"):
     # 8 virtual device threads share ONE core here: at big-model scale
-    # (test_zero3_13b full run) they reach a collective's rendezvous
-    # minutes apart, tripping XLA-CPU's default 40 s terminate deadline
-    _flags += (" --xla_cpu_collective_call_terminate_timeout_seconds=3600"
-               " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600")
+    # (test_zero3_13b full run, DS_TPU_RUN_13B=1) they reach a
+    # collective's rendezvous minutes apart, tripping XLA-CPU's default
+    # 40 s terminate deadline. XLA aborts the PROCESS on unknown flags
+    # (parse_flags_from_env), and newer builds dropped these names — so
+    # they are gated to the 13B run and probed in a subprocess first;
+    # the regular tier never risks the abort.
+    _cand = (" --xla_cpu_collective_call_terminate_timeout_seconds=3600"
+             " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600")
+    import subprocess
+    import sys
+    _probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        env={**os.environ, "XLA_FLAGS": _flags + _cand,
+             "JAX_PLATFORMS": "cpu"},
+        capture_output=True)
+    if _probe.returncode == 0:
+        _flags += _cand
 os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402

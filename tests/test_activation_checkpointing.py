@@ -97,8 +97,11 @@ def test_policy_escape_hatch():
     g_pol = jax.grad(lambda p: _loss(p, x, True))(params)
     g_ref = jax.grad(lambda p: _loss(p, x, False))(params)
     for k in params:
+        # atol floor: remat changes XLA's fusion/reduction order, which
+        # legitimately moves fp32 grads by ~1 ulp on some XLA versions
         np.testing.assert_allclose(np.asarray(g_pol[k]),
-                                   np.asarray(g_ref[k]), rtol=1e-5)
+                                   np.asarray(g_ref[k]), rtol=1e-5,
+                                   atol=1e-6)
 
 
 def test_partition_activations_shards_saved_inputs():
