@@ -277,6 +277,33 @@ def get_mesh_config(param_dict):
     return get_scalar_param(param_dict, C.MESH, None)
 
 
+def get_async_dispatch_enabled(param_dict):
+    block = param_dict.get(C.ASYNC_DISPATCH, {})
+    return get_scalar_param(block, C.ASYNC_DISPATCH_ENABLED,
+                            C.ASYNC_DISPATCH_ENABLED_DEFAULT)
+
+
+def get_async_dispatch_steps_per_sync(param_dict):
+    block = param_dict.get(C.ASYNC_DISPATCH, {})
+    val = get_scalar_param(block, C.ASYNC_DISPATCH_STEPS_PER_SYNC,
+                           C.ASYNC_DISPATCH_STEPS_PER_SYNC_DEFAULT)
+    if val < 0:
+        raise DeepSpeedConfigError(
+            f"async_dispatch.steps_per_sync must be >= 0 (0 = follow "
+            f"steps_per_print), got {val}")
+    return int(val)
+
+
+def get_async_dispatch_prefetch_depth(param_dict):
+    block = param_dict.get(C.ASYNC_DISPATCH, {})
+    val = get_scalar_param(block, C.ASYNC_DISPATCH_PREFETCH_DEPTH,
+                           C.ASYNC_DISPATCH_PREFETCH_DEPTH_DEFAULT)
+    if val < 1:
+        raise DeepSpeedConfigError(
+            f"async_dispatch.prefetch_depth must be >= 1, got {val}")
+    return int(val)
+
+
 class DeepSpeedConfigWriter:
     """Minimal key-value holder used by tests/tools to compose configs."""
 
@@ -439,6 +466,12 @@ class DeepSpeedConfig:
         self.sparse_attention = get_sparse_attention(param_dict)
         self.pipeline = get_pipeline_config(param_dict)
         self.mesh = get_mesh_config(param_dict)
+
+        self.async_dispatch_enabled = get_async_dispatch_enabled(param_dict)
+        self.async_dispatch_steps_per_sync = \
+            get_async_dispatch_steps_per_sync(param_dict)
+        self.async_dispatch_prefetch_depth = \
+            get_async_dispatch_prefetch_depth(param_dict)
 
         self.pld_enabled = get_pld_enabled(param_dict)
         self.pld_params = get_pld_params(param_dict)

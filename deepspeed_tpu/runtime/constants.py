@@ -210,6 +210,32 @@ MESH_MODEL_AXIS = "model"
 MESH_PIPE_AXIS = "pipe"
 
 #############################################
+# Async dispatch (TPU-native extension): keep N steps in flight.
+#   {"async_dispatch": {"enabled": true, "steps_per_sync": 0,
+#                       "prefetch_depth": 2}}
+# enabled: compile the LR schedule into the jitted step (device-resident
+#   function of the device step counter — no per-step host scalar
+#   upload) and drop the per-step fp16 `device_get(overflow)` host sync;
+#   the scheduler's overflow-skip semantics moves on-device (skipped
+#   steps don't bump `global_steps`). Host-side metrics (lr mirror,
+#   loss scale, TensorBoard) are fetched only at sync fences.
+#   Disabled automatically under ZeRO-Offload (the host optimizer step
+#   is inherently synchronous) and when a client lr_scheduler object is
+#   passed (arbitrary host code can't be compiled into the step).
+# steps_per_sync: fence cadence in optimizer steps; 0 = follow
+#   steps_per_print.
+# prefetch_depth: staged batches the background PrefetchLoader
+#   (runtime/prefetch.py) keeps in flight ahead of the step loop.
+#############################################
+ASYNC_DISPATCH = "async_dispatch"
+ASYNC_DISPATCH_ENABLED = "enabled"
+ASYNC_DISPATCH_ENABLED_DEFAULT = True
+ASYNC_DISPATCH_STEPS_PER_SYNC = "steps_per_sync"
+ASYNC_DISPATCH_STEPS_PER_SYNC_DEFAULT = 0
+ASYNC_DISPATCH_PREFETCH_DEPTH = "prefetch_depth"
+ASYNC_DISPATCH_PREFETCH_DEPTH_DEFAULT = 2
+
+#############################################
 # ZeRO-Offload compressed wire (TPU-native extension): the host link is
 # the bottleneck of the offload round trip, so the wire format is
 # configurable under zero_optimization.offload_wire:

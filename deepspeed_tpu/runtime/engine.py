@@ -20,6 +20,14 @@ mutation), redesigned around XLA's compilation model:
     update) — the overflow decision never leaves the chip unless fp16
     stats are being reported (ref does a Python-side skip,
     `stage2.py:1346-1368`).
+  * async dispatch (default on): the LR schedule is a device-resident
+    function of the device `global_steps` counter compiled into the
+    step, so the hot loop performs NO host<->device synchronization —
+    no per-step lr upload, no `device_get(overflow)` (overflow-skipped
+    steps simply don't bump `global_steps`, which IS the reference's
+    "scheduler doesn't advance past an overflow step" semantics).
+    Host-side metrics sync only at `steps_per_sync` fences; batches
+    prefetch on a background thread (`runtime/prefetch.py`).
 
 The three-call API (`engine(batch)` / `engine.backward(loss)` /
 `engine.step()`) is preserved for drop-in compatibility; `train_batch`
@@ -49,6 +57,7 @@ from deepspeed_tpu.runtime.fp16.loss_scaler import (
     MIN_LOSS_SCALE)
 from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+from deepspeed_tpu.runtime.prefetch import PrefetchLoader
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
 from deepspeed_tpu.runtime.checkpoint import (save_checkpoint_files,
                                               load_checkpoint_files,
@@ -228,6 +237,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         # ---- optimizer + sharding + state ----
         self._rng = jax.random.PRNGKey(rng_seed)
+        # cached device constant: the no-PLD keep_prob; building a fresh
+        # scalar per step would put a tiny H2D transfer on the hot path
+        self._keep_prob_one = jnp.asarray(1.0, jnp.float32)
+        self._steps_per_sync = \
+            self._config.async_dispatch_steps_per_sync or \
+            self.steps_per_print()
         self._configure_optimizer()
         self._configure_lr_scheduler(lr_scheduler)
         self._init_state()
@@ -372,6 +387,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
     def steps_per_print(self):
         return self._config.steps_per_print
+
+    def async_dispatch_enabled(self):
+        """Effective async-dispatch mode (the config flag, vetoed when a
+        client lr_scheduler object or ZeRO-Offload forces sync)."""
+        return self._async_dispatch
+
+    def steps_per_sync(self):
+        """Host<->device metrics-fence cadence in optimizer steps
+        (async_dispatch.steps_per_sync, or steps_per_print when 0)."""
+        return self._steps_per_sync
+
+    def prefetch_depth(self):
+        return self._config.async_dispatch_prefetch_depth
 
     def wall_clock_breakdown(self):
         return self._config.wall_clock_breakdown
@@ -572,12 +600,27 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self.optimizer = self  # `engine.optimizer` parity: exposes state
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
+        # Async dispatch needs a schedule it can compile into the step:
+        # a client scheduler object is arbitrary host code (sync mode),
+        # and ZeRO-Offload's host optimizer step is a sync by nature.
+        self._device_lr_fn = None
+        self._async_dispatch = (self._config.async_dispatch_enabled and
+                                client_lr_scheduler is None and
+                                not self._offload_enabled())
         if client_lr_scheduler is not None:
             self.lr_scheduler = client_lr_scheduler
+            if self._config.async_dispatch_enabled:
+                log_dist(
+                    "async_dispatch: disabled — a client lr_scheduler "
+                    "object cannot be compiled into the jitted step "
+                    "(use the config scheduler block for the sync-free "
+                    "hot path)", ranks=[0])
             return
         name = self.scheduler_name()
         if name is None:
             self.lr_scheduler = None
+            self._device_lr_fn = lr_schedules.device_schedule_fn(
+                None, base_lr=self._base_lr)
             return
         sched_cls = {
             lr_schedules.LR_RANGE_TEST: lr_schedules.LRRangeTest,
@@ -589,7 +632,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             raise ValueError(f"Unknown scheduler {name}")
         params = self.scheduler_params() or {}
         self.lr_scheduler = sched_cls(self._optimizer_shim, **params)
-        log_dist(f"Using LR scheduler {name}", ranks=[0])
+        self._device_lr_fn = lr_schedules.device_schedule_fn(name, params)
+        log_dist(f"Using LR scheduler {name}"
+                 + (" (device-resident under async dispatch)"
+                    if self._async_dispatch else ""), ranks=[0])
 
     def _current_lr(self):
         if self.lr_scheduler is not None:
@@ -601,6 +647,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         return float(self._base_lr if self._base_lr is not None else 0.0)
 
     def get_lr(self):
+        # Under async fp16 the host scheduler is an optimistic mirror;
+        # an explicit lr query is a user-initiated sync point (like
+        # loss_scale()), so refresh it first.
+        self._sync_scheduler_mirror()
         return [self._current_lr()]
 
     def get_mom(self):
@@ -1037,6 +1087,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             (1 - overflow.astype(jnp.int32)))
         return new_state, overflow, grad_norm
 
+    def _resolve_step_lr(self, state, lr):
+        """Inside-jit lr resolution: under async dispatch the host
+        passes lr=None and the schedule is evaluated HERE, on the
+        device-side count of successful steps — no host scalar ever
+        rides the step. `global_steps` doesn't advance on an fp16
+        overflow skip, so the schedule holds still across skipped
+        steps exactly like the reference's host-side rewind. lr=None
+        with no device schedule (client optax optimizer) passes
+        through to `_with_lr`'s leave-untouched path."""
+        if lr is None and self._device_lr_fn is not None:
+            return self._device_lr_fn(state.global_steps)
+        return lr
+
     def _with_lr(self, opt_state, lr):
         """Override injected learning_rate hyperparam with a traced scalar.
         lr=None (client optimizer with no scheduler) leaves the client's
@@ -1105,6 +1168,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._accum_jit = jax.jit(accum_fn, donate_argnums=(0,))
 
         def apply_fn(state, lr):
+            lr = self._resolve_step_lr(state, lr)
             return self._unscale_clip_and_update(state, lr)
 
         self._apply_jit = jax.jit(apply_fn, donate_argnums=(0,))
@@ -1127,6 +1191,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         def fused_train_step(state, stacked_batch, rng, lr, keep_prob):
             """scan over gas microbatches then update; one compile."""
+            lr = self._resolve_step_lr(state, lr)
             micro = lambda mb, r: self._micro_grad(
                 state.params, mb, r, state.scale.loss_scale, keep_prob)
             out, loss = self._scan_microbatches(
@@ -1177,6 +1242,8 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         gas = self._jit_gas()
 
         def local_step(state, stacked_batch, rng, lr, keep_prob):
+            lr = self._resolve_step_lr(state, lr)
+
             def micro(mb, mb_rng):
                 mb_rng = jax.random.fold_in(
                     mb_rng, jax.lax.axis_index(DATA_AXIS))
@@ -1278,7 +1345,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self.progressive_layer_drop is not None:
             return jnp.asarray(self.progressive_layer_drop.get_theta(),
                                jnp.float32)
-        return jnp.asarray(1.0, jnp.float32)
+        return self._keep_prob_one
 
     def forward(self, batch, **kwargs):
         """Compute loss (and cache grads for `backward`)."""
@@ -1301,7 +1368,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     __call__ = forward
 
     def backward(self, loss=None, allreduce_gradients=True, release_loss=False):
-        """Fold the cached microbatch grads into the accumulator."""
+        """Fold the cached microbatch grads into the accumulator.
+
+        release_loss=True drops the engine's own reference to the loss
+        buffer (ref engine.py:934): `engine.losses` stays None and the
+        device buffer frees as soon as the caller's reference dies —
+        use it when the loop never reads `engine.losses`."""
         assert self._pending_grads is not None, \
             "backward() called without a preceding forward()"
         if self.wall_clock_breakdown():
@@ -1316,10 +1388,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                                   self._pending_grads)
         self.state = self.state._replace(acc_grads=acc)
         self._pending_grads = None
+        if release_loss:
+            self._pending_loss = None
+            self.losses = None
+        else:
+            self.losses = loss if loss is not None else self._pending_loss
         if self.wall_clock_breakdown():
             self.timers(BACKWARD_MICRO_TIMER).stop()
             self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
+
+    def _release_pending_loss(self):
+        """Drop the forward()-cached loss reference at the end of
+        step(): keeping it pinned would hold one stale device buffer
+        alive across every subsequent step."""
+        self._pending_loss = None
 
     def step(self, lr_kwargs=None):
         """Advance one micro step; at the grad-accum boundary, apply the
@@ -1330,6 +1413,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self.is_gradient_accumulation_boundary():
             self._take_model_step(lr_kwargs)
         self.micro_steps += 1
+        self._release_pending_loss()
         if self.wall_clock_breakdown():
             self.timers(STEP_MICRO_TIMER).stop()
             self.timers(STEP_GLOBAL_TIMER).stop()
@@ -1340,7 +1424,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 ])
 
     def _take_model_step(self, lr_kwargs=None):
-        lr = self._next_lr()
+        lr = self._host_step_lr()
         if self._offload_enabled():
             overflow = self._offload_take_step(lr)
             self._host_steps += 1
@@ -1369,28 +1453,75 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             return None
         return float(self._base_lr)
 
+    def _host_step_lr(self):
+        """Per-step host half of the lr plumbing. Sync mode: advance
+        the scheduler and return the concrete scalar (uploaded as a
+        step argument). Async mode: advance the host scheduler as an
+        OPTIMISTIC mirror — pure Python, no device work, exact except
+        across fp16 overflow skips (fence-corrected) — and return None:
+        the jitted step computes the lr on device."""
+        if not self._async_dispatch:
+            return self._next_lr()
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        return None
+
+    def _sync_scheduler_mirror(self):
+        """Correct the optimistic host scheduler mirror from the device
+        step counter (one device_get). Only fp16 overflow skips can make
+        the mirror drift, so this is a no-op everywhere else."""
+        if self._async_dispatch and self.fp16_mode and \
+                self.lr_scheduler is not None:
+            gs = int(jax.device_get(self.state.global_steps))
+            if self.lr_scheduler.last_batch_iteration != gs - 1:
+                self.lr_scheduler.step(gs - 1)
+
     def _after_model_step(self, overflow):
-        if self.fp16_mode:
-            # Host sync only in fp16 mode (parity: scheduler doesn't
-            # advance past an overflow step in the reference).
+        if self.fp16_mode and not self._async_dispatch:
+            # Legacy synced loop: host-side scheduler rewind (parity:
+            # scheduler doesn't advance past an overflow step in the
+            # reference). This device_get serializes host and device
+            # every step; async mode gets the same semantics for free
+            # from the device-resident schedule.
             if bool(jax.device_get(overflow)) and \
                     self.lr_scheduler is not None:
                 self.lr_scheduler.step(
                     self.lr_scheduler.last_batch_iteration - 1)
+        # print fences are fences too: a steps_per_sync that doesn't
+        # divide into the print multiples must not suppress
+        # steps_per_print output
+        if self._host_steps % self._steps_per_sync == 0 or \
+                self._host_steps % self.steps_per_print() == 0:
+            self._sync_fence()
+
+    def _sync_fence(self):
+        """The hot loop's only host<->device rendezvous: refresh the
+        scheduler mirror and materialize device metrics (step counters,
+        loss, lr, loss scale) for logging/TensorBoard. Runs every
+        `steps_per_sync` optimizer steps (default: steps_per_print)."""
+        self._sync_scheduler_mirror()
         at_print = self._host_steps % self.steps_per_print() == 0
         if self.summary_writer is not None and at_print:
             gs = self.global_steps
+            samples = gs * self.train_batch_size()
             self.summary_writer.add_scalar(
-                "Train/Samples/lr", self._current_lr(),
-                gs * self.train_batch_size())
+                "Train/Samples/lr", self._current_lr(), samples)
+            if self.losses is not None:
+                self.summary_writer.add_scalar(
+                    "Train/Samples/train_loss",
+                    float(np.asarray(jax.device_get(self.losses))),
+                    samples)
             if self.fp16_mode:
                 self.summary_writer.add_scalar(
                     "Train/Samples/loss_scale", self.loss_scale(),
-                    gs * self.train_batch_size())
+                    samples)
         if at_print:
+            # _current_lr, not get_lr(): the mirror was synced above and
+            # get_lr() would pay a second device round trip for it
             log_dist(
                 f"step={self.global_steps}, skipped={self.skipped_steps}, "
-                f"lr={self.get_lr()}, mom={self.get_mom()}", ranks=[0])
+                f"lr={[self._current_lr()]}, mom={self.get_mom()}",
+                ranks=[0])
 
     def stage_batch(self, batch):
         """Place a stacked [gas, micro_bs, ...] batch pytree on device
@@ -1412,16 +1543,33 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         return jax.tree_util.tree_map(put_stacked, batch)
 
+    def prefetch(self, data_source, depth=None, stacked=False):
+        """Wrap a microbatch iterable in a background PrefetchLoader:
+        collation + `stage_batch` placement run on a worker thread,
+        `depth` (default async_dispatch.prefetch_depth) staged batches
+        ahead of the step loop. Feed the result to `train_batch` as
+        `data_iter`."""
+        return PrefetchLoader(
+            data_source, stage_fn=self.stage_batch, gas=self._jit_gas(),
+            depth=depth if depth is not None else self.prefetch_depth(),
+            stacked=stacked)
+
     def train_batch(self, data_iter=None, batch=None):
         """Fast path: one fused jitted step over all grad-accum
-        microbatches. Pass either an iterator yielding microbatches or a
+        microbatches. Pass an iterator yielding microbatches, a
+        PrefetchLoader (pre-staged batches, no host collate here), or a
         pre-stacked batch pytree with leading dim [gas, micro_bs, ...]."""
         gas = self._jit_gas()
         if batch is None:
             assert data_iter is not None
-            micro = [next(data_iter) for _ in range(gas)]
-            batch = jax.tree_util.tree_map(
-                lambda *xs: np.stack([np.asarray(x) for x in xs]), *micro)
+            if isinstance(data_iter, PrefetchLoader):
+                # collated + staged on the prefetch worker thread
+                batch = next(data_iter)
+            else:
+                micro = [next(data_iter) for _ in range(gas)]
+                batch = jax.tree_util.tree_map(
+                    lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                    *micro)
         else:
             leading = jax.tree_util.tree_leaves(batch)[0].shape[0]
             assert leading == gas, \
@@ -1429,7 +1577,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         self.tput_timer.start()
         batch = self.stage_batch(batch)
-        lr = self._next_lr()
+        lr = self._host_step_lr()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)
         if self.flops_profiler_enabled() and \
@@ -1469,10 +1617,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         mbs = self._microbatches_per_step()
         self.micro_steps += mbs
         self._host_steps += 1
+        # losses before the fence: _sync_fence logs THIS step's loss
+        self.losses = loss
         self._after_model_step(overflow)
         # one fused step consumed `mbs` microbatches worth of samples
         self.tput_timer.stop(count=mbs)
-        self.losses = loss
         return loss
 
     def _profile_fused_step(self, batch, lr):
@@ -1570,6 +1719,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        # the checkpoint must carry the TRUE schedule position, not the
+        # optimistic async mirror (drifts across fp16 overflow skips)
+        self._sync_scheduler_mirror()
         if tag is None:
             tag = f"global_step{self.global_steps}"
         if self.checkpoint_tag_validation_enabled():

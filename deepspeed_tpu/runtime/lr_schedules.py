@@ -6,6 +6,17 @@ schedule wraps an optimizer-like object exposing `param_groups` (the TPU
 engine provides a single-group shim) and the engine reads the scalar lr
 each step and feeds it to the jitted update as a traced argument, so lr
 changes never trigger recompilation.
+
+Each schedule also has a DEVICE-RESIDENT form (`device_schedule_fn`): a
+pure `jnp` function of the step counter, compiled straight into the
+engine's fused train step. Under async dispatch the engine evaluates it
+on the device-side `global_steps` counter, so no host scalar is computed
+or uploaded per step — and because overflow-skipped fp16 steps don't
+bump `global_steps`, the reference's "scheduler doesn't advance past an
+overflow step" semantics needs no host rewind (and no per-step
+`device_get`). `device_schedule_fn(name, params)(step)` equals the host
+class's `get_lr()[0]` evaluated at `last_batch_iteration == step`
+(fp32 math on device vs float64 on host — parity to ~1e-6 relative).
 """
 
 import math
@@ -373,6 +384,102 @@ class WarmupLR(_BaseSchedule):
             return self.inverse_log_warm_up * \
                 math.log(self.last_batch_iteration + 1)
         return 1.0
+
+
+def device_schedule_fn(name, params=None, base_lr=None):
+    """Device-resident schedule: a pure-jnp `lr(step)` for compiling
+    into a jitted train step.
+
+    `step` is the count of prior SUCCESSFUL optimizer steps (the
+    engine's device `global_steps` counter), which equals the host
+    scheduler's `last_batch_iteration` at lr-evaluation time: the host
+    path calls `step()` (incrementing -1→0 on the first step) before
+    reading the lr, and rewinds on overflow.
+
+    name=None returns a constant-`base_lr` schedule (or None when
+    base_lr is None — client optimizers keep their own lr). `params`
+    uses the JSON scheduler-param keys; defaults match the host
+    classes. Accepts scalar or array `step` (the parity sweep
+    evaluates whole ranges at once).
+    """
+    import jax.numpy as jnp
+
+    if name is None:
+        if base_lr is None:
+            return None
+        const = float(base_lr)
+        return lambda step: jnp.full(jnp.shape(step), const, jnp.float32)
+    if name not in VALID_LR_SCHEDULES:
+        raise ValueError(f"Unknown scheduler {name}")
+    p = dict(params or {})
+
+    def f32(x):
+        return jnp.asarray(x, jnp.float32)
+
+    if name == LR_RANGE_TEST:
+        min_lr = float(p.get(LR_RANGE_TEST_MIN_LR, 1e-3))
+        step_size = float(p.get(LR_RANGE_TEST_STEP_SIZE, 2000))
+        step_rate = float(p.get(LR_RANGE_TEST_STEP_RATE, 1.0))
+        staircase = bool(p.get(LR_RANGE_TEST_STAIRCASE, False))
+
+        def lr_range_test(step):
+            interval = (f32(step) + 1.0) / step_size
+            if staircase:
+                interval = jnp.floor(interval)
+            return f32(min_lr * (1.0 + step_rate * interval))
+        return lr_range_test
+
+    if name == ONE_CYCLE:
+        cycle_min_lr = float(p[CYCLE_MIN_LR])
+        cycle_max_lr = float(p[CYCLE_MAX_LR])
+        decay_lr_rate = float(p.get(DECAY_LR_RATE, 0.0))
+        first = float(p.get(CYCLE_FIRST_STEP_SIZE, 2000))
+        second = p.get(CYCLE_SECOND_STEP_SIZE)
+        second = float(second) if second is not None else first
+        total_size = first + second
+        step_ratio = first / total_size
+        decay_step_size = float(p.get(DECAY_STEP_SIZE, 0))
+        # the decay branch divides by decay_step_size; guard the traced
+        # (always-evaluated) branch — selected only past total_size,
+        # where the host class requires a positive decay_step_size too
+        decay_div = max(decay_step_size, 1.0)
+
+        def one_cycle(step):
+            step = f32(step)
+            bi = step + 1.0
+            cycle = jnp.floor(1.0 + bi / total_size)
+            x = 1.0 + bi / total_size - cycle
+            scale = jnp.where(x <= step_ratio, x / step_ratio,
+                              (x - 1.0) / (step_ratio - 1.0))
+            cycle_lr = cycle_min_lr + \
+                (cycle_max_lr - cycle_min_lr) * scale
+            decay_interval = (step - total_size + 1.0) / decay_div
+            decay_lr = cycle_min_lr / \
+                (1.0 + decay_lr_rate * decay_interval)
+            return f32(jnp.where(step < total_size, cycle_lr, decay_lr))
+        return one_cycle
+
+    # WarmupLR / WarmupDecayLR
+    warmup_min_lr = float(p.get(WARMUP_MIN_LR, 0.0))
+    warmup_max_lr = float(p.get(WARMUP_MAX_LR, 0.001))
+    warmup_num_steps = max(2, int(p.get(WARMUP_NUM_STEPS, 1000)))
+    delta_lr = warmup_max_lr - warmup_min_lr
+    inv_log_warmup = 1.0 / math.log(warmup_num_steps)
+    total_num_steps = int(p[TOTAL_NUM_STEPS]) \
+        if name == WARMUP_DECAY_LR else None
+
+    def warmup_lr(step):
+        step = f32(step)
+        warm_gamma = inv_log_warmup * jnp.log(step + 1.0)
+        if total_num_steps is None:
+            post = 1.0
+        else:
+            post = jnp.maximum(
+                0.0, (total_num_steps - step) /
+                max(1.0, float(total_num_steps - warmup_num_steps)))
+        gamma = jnp.where(step < warmup_num_steps, warm_gamma, post)
+        return f32(warmup_min_lr + delta_lr * gamma)
+    return warmup_lr
 
 
 class WarmupDecayLR(WarmupLR):

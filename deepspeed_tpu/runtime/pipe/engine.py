@@ -246,6 +246,7 @@ class PipelineEngine(DeepSpeedEngine):
             return
 
         def pipe_step(state, stacked_batch, rng, lr, keep_prob):
+            lr = self._resolve_step_lr(state, lr)
             loss, grads = self._interp_fn(
                 state.params, stacked_batch, rng, state.scale.loss_scale)
             # join the padded layout when ZeRO pads odd leaves (same as

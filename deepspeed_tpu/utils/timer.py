@@ -181,10 +181,17 @@ class ThroughputTimer:
     def avg_samples_per_sec(self):
         """Cumulative samples/sec over all completed measurement windows
         (post-warmup). Safe to call mid-window — unfenced in-flight steps
-        are simply not counted yet."""
+        are simply not counted yet; before the first fenced window it
+        returns 0.0 (not -inf: callers feed this into logs/ratios).
+
+        Units: `_measured_steps` counts MICROBATCHES (`stop(count=...)`),
+        and one microbatch consumes `batch_size` (micro-batch per
+        worker) × `num_workers` samples globally — so gas>1 fused steps
+        (count=gas) and dp>1 both cancel out to
+        train_batch_size × optimizer-steps / elapsed."""
         measured = getattr(self, "_measured_steps", 0)
         if measured > 0 and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
             avg_time_per_step = self.total_elapsed_time / measured
             return samples_per_step / avg_time_per_step
-        return float("-inf")
+        return 0.0

@@ -12,6 +12,37 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _bench_proc(*argv, timeout=120):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; runpy.run_path("
+            f"{os.path.join(REPO, 'bench.py')!r}, run_name='__main__')")
+    return subprocess.run(
+        [sys.executable, "-c", code] + list(argv),
+        capture_output=True, text=True, env=env, cwd=REPO,
+        timeout=timeout)
+
+
+def test_bench_list_prints_legs():
+    proc = _bench_proc("--list")
+    assert proc.returncode == 0, proc.stderr[-500:]
+    legs = proc.stdout.split()
+    assert "async_dispatch" in legs and "zero_offload_wire" in legs
+
+
+def test_bench_only_unknown_leg_fails_with_list():
+    proc = _bench_proc("--only", "no_such_leg")
+    assert proc.returncode != 0
+    err = proc.stderr
+    assert "no_such_leg" in err
+    # the error must NAME the valid legs, not silently run nothing
+    assert "async_dispatch" in err and "gpt2_350m" in err
+
+
 def test_bench_emits_one_json_line():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
