@@ -35,6 +35,7 @@ tunnel and may be SHARED):
 """
 
 import json
+import os
 import time
 
 import jax
@@ -1029,6 +1030,175 @@ def bench_async_dispatch():
     return out
 
 
+def bench_async_checkpoint():
+    """Zero-stall async checkpointing A/B (ISSUE 3): the SAME training
+    loop with a save_checkpoint dropped into the middle of a timed
+    window, run with checkpoint.async_save=false (legacy inline
+    device_get + npz serialization on the train loop) vs =true (the
+    loop pays only the device-side snapshot; a background writer
+    serializes into `<tag>.tmp` and commits atomically). Reports
+    steps/s over the save window, the isolated stall (save-window wall
+    minus a no-save baseline window, best-of-N interleaved), the
+    blocking time of the save_checkpoint call itself, and two
+    bit-identical checks: an async-saved checkpoint vs a sync-saved
+    one of the same state — with training continuing (donating
+    buffers / mutating host masters in place) while the writer is
+    still serializing — for (a) the bf16+master ZeRO-2 engine and
+    (b) a ZeRO-Offload engine with the compressed int8 wire (masters,
+    Adam moments, wire shadow/residual included)."""
+    import shutil
+    import tempfile
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+    from deepspeed_tpu import initialize
+    from deepspeed_tpu.runtime.checkpoint import checkpoint_dirs_bit_identical
+
+    batch, seq = 8, 64
+    steps, save_at, windows = 12, 6, 3
+    # ~7M params -> ~130 MB of fp32 master+moments+module per save:
+    # enough that inline serialization stalls the loop for many steps,
+    # small enough for the CPU smoke run
+    cfg = tiny_gpt2_config(n_layer=4, n_embd=384, n_head=8,
+                           n_positions=seq)
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    def build(async_save, extra=None):
+        model = GPT2ForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((batch, seq),
+                                                   np.int32)})
+        config = {
+            "train_micro_batch_size_per_gpu": batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 100000,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "checkpoint": {"async_save": async_save},
+        }
+        config.update(extra or {})
+        engine, _, _, _ = initialize(model=model, model_parameters=params,
+                                     config=config)
+        del params
+        for i in range(3):
+            loss = engine.train_batch(batch=make_batch(i))
+        _sync(loss)
+        return engine
+
+    def window(engine, save_dir=None, tag=None):
+        save_call = 0.0
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = engine.train_batch(batch=make_batch(100 + i))
+            if i == save_at and save_dir is not None:
+                s0 = time.perf_counter()
+                engine.save_checkpoint(save_dir, tag=tag)
+                save_call = time.perf_counter() - s0
+        _sync(loss)
+        return time.perf_counter() - t0, save_call
+
+    tmp = tempfile.mkdtemp(prefix="ds_async_ckpt_bench_")
+    out = {}
+    try:
+        engines = {"sync": build(False), "async": build(True)}
+        rec = {k: {"base": [], "save": [], "stall": [], "save_call": []}
+               for k in engines}
+        # interleaved windows: load drift hits both legs equally; the
+        # stall is computed PAIRWISE (save window minus the adjacent
+        # no-save window from the same load regime), then medianed —
+        # robust against drift in a way best-of subtraction is not
+        for w in range(windows):
+            for name, engine in engines.items():
+                b, _ = window(engine)
+                s, call = window(engine, tmp, f"{name}_w{w}")
+                # the commit itself happens off the timed window; the
+                # barrier here also bounds disk usage across windows
+                engine.wait_for_checkpoint()
+                r = rec[name]
+                r["base"].append(b)
+                r["save"].append(s)
+                r["stall"].append(s - b)
+                r["save_call"].append(call)
+
+        def leg(name):
+            r = rec[name]
+            stall = max(float(np.median(r["stall"])), 0.0)
+            return {
+                "steps_per_sec_baseline": round(
+                    steps / min(r["base"]), 2),
+                "steps_per_sec_with_save": round(
+                    steps / min(r["save"]), 2),
+                "train_loop_stall_ms": round(stall * 1e3, 1),
+                "save_call_blocked_ms": round(
+                    float(np.median(r["save_call"])) * 1e3, 1),
+            }, stall
+
+        out["sync"], stall_sync = leg("sync")
+        out["async"], stall_async = leg("async")
+        out["stall_reduction"] = round(
+            stall_sync / max(stall_async, 1e-3), 1)
+        out["save_call_speedup"] = round(
+            float(np.median(rec["sync"]["save_call"])) /
+            max(float(np.median(rec["async"]["save_call"])), 1e-4), 1)
+
+        # bit-identical under concurrent training: sync and async save
+        # of the SAME state, then keep stepping (buffer donation) while
+        # the writer is still serializing
+        e = engines["async"]
+        e.save_checkpoint(tmp, tag="bit_sync", async_save=False,
+                          save_latest=False)
+        e.save_checkpoint(tmp, tag="bit_async")
+        for i in range(2):
+            loss = e.train_batch(batch=make_batch(500 + i))
+        _sync(loss)
+        e.wait_for_checkpoint()
+        out["bit_identical"] = checkpoint_dirs_bit_identical(
+            os.path.join(tmp, "bit_sync"), os.path.join(tmp, "bit_async"))
+
+        # same check for ZeRO-Offload wire state (host masters + Adam
+        # moments + int8 shadow/residual): train_batch mutates the host
+        # master IN PLACE while the writer runs
+        del engines
+        wire_cfg = tiny_gpt2_config(n_positions=seq, dropout=0.0)
+        model = GPT2ForCausalLM(wire_cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((batch, seq),
+                                                   np.int32)})
+        oe, _, _, _ = initialize(
+            model=model, model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 100000,
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                      "offload_wire": {"grad_bits": 8,
+                                                       "param_bits": 8}},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            })
+        del params
+        for i in range(3):
+            loss = oe.train_batch(batch=make_batch(i))
+        _sync(loss)
+        oe.save_checkpoint(tmp, tag="wire_sync", async_save=False,
+                           save_latest=False)
+        oe.save_checkpoint(tmp, tag="wire_async", async_save=True)
+        for i in range(2):
+            loss = oe.train_batch(batch=make_batch(600 + i))
+        _sync(loss)
+        oe.wait_for_checkpoint()
+        out["offload_wire_bit_identical"] = checkpoint_dirs_bit_identical(
+            os.path.join(tmp, "wire_sync"),
+            os.path.join(tmp, "wire_async"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def timeit_once(fn):
     t0 = time.perf_counter()
     fn()
@@ -1039,6 +1209,7 @@ def timeit_once(fn):
 # extras; each returns one JSON-able dict). Order matters: the full
 # suite runs the TPU legs in this order, then the memory plan.
 BENCH_LEGS = {
+    "async_checkpoint": bench_async_checkpoint,
     "async_dispatch": bench_async_dispatch,
     "gpt2_350m": bench_gpt2_350m,
     "bert_large_fused_seq128": bench_bert_large,

@@ -28,10 +28,13 @@ Semantics, TPU-native:
 Legacy (round-1) pickle checkpoints are still readable, with a warning.
 """
 
+import contextlib
 import json
 import os
 import pickle
 import re
+import shutil
+import threading
 
 import jax
 import numpy as np
@@ -42,6 +45,11 @@ MODEL_STATES_FMT = "mp_rank_{:02d}_model_states"
 OPTIM_SHARD_FMT = "zero_pp_rank_{}_mp_rank_{:02d}optim_states"
 MODEL_SHARD_FMT = "zero_pp_rank_{}_mp_rank_{:02d}model_states"
 LATEST_FILE = "latest"
+
+# Suffix of the in-progress staging directory an async (or crashed)
+# save writes into before the atomic rename to `<tag>`. Readers must
+# never treat one as a checkpoint.
+STAGING_SUFFIX = ".tmp"
 
 _SHARD_RE = re.compile(
     r"zero_pp_rank_(\d+)_mp_rank_(\d+)(optim|model)_states\.npz$")
@@ -203,15 +211,19 @@ def _json_restore(obj):
     return obj
 
 
-def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0):
+def save_checkpoint_files(save_dir, tag, model_sd, optim_sd, mp_rank=0,
+                          ckpt_dir=None):
     """Write a sharded checkpoint.
 
     `model_sd` — dict with a "module" pytree of (possibly sharded) jax
     arrays plus JSON-able metadata entries.  `optim_sd` — dict with an
     "opt_state" pytree plus metadata; may be None.  All processes must
     call this (each writes its own shards); process 0 writes manifests.
+    `ckpt_dir` overrides the destination directory (the async writer
+    points it at the `<tag>.tmp` staging dir and renames on commit).
     """
-    ckpt_dir = _ckpt_dir(save_dir, tag)
+    if ckpt_dir is None:
+        ckpt_dir = _ckpt_dir(save_dir, tag)
     os.makedirs(ckpt_dir, exist_ok=True)
 
     module = model_sd.get("module", {})
@@ -317,6 +329,15 @@ def load_checkpoint_flat(load_dir, tag, mp_rank=0):
     optim_meta, has_optim).  Paths are prefixed "module"/"optim"/"aux"."""
     ckpt_dir = _ckpt_dir(load_dir, tag)
     base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
+    if not os.path.exists(base + ".json") and \
+            os.path.isdir(staging_dir(load_dir, tag)):
+        # `<tag>.tmp` without `<tag>`: a save was killed before its
+        # atomic commit — the staging dir must never be loaded
+        raise FileNotFoundError(
+            f"checkpoint tag '{tag}' in {load_dir} only exists as an "
+            f"incomplete staging dir ('{tag}{STAGING_SUFFIX}') left by "
+            "an interrupted save; load an earlier tag (see the "
+            "'latest' pointer)")
     with open(base + ".json") as f:
         manifest = json.load(f)
     version = manifest.get("format_version", 1)
@@ -400,12 +421,322 @@ def load_checkpoint_files(load_dir, tag, zero_enabled=True, mp_rank=0,
 
 
 # ----------------------------------------------------------------------
+# durability: fsync helpers, staging-dir commit, latest tag, rotation
+# ----------------------------------------------------------------------
+def _fsync_path(path):
+    """fsync a file (or directory) by descriptor; directory fsync is
+    best-effort — not all filesystems support it."""
+    flags = os.O_RDONLY
+    if os.path.isdir(path) and hasattr(os, "O_DIRECTORY"):
+        flags |= os.O_DIRECTORY
+    try:
+        fd = os.open(path, flags)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def staging_dir(save_dir, tag):
+    """The `<tag>.tmp` directory an in-progress save writes into."""
+    return _ckpt_dir(save_dir, tag) + STAGING_SUFFIX
+
+
+def is_staging_name(name):
+    return str(name).endswith(STAGING_SUFFIX)
+
+
+def commit_staging_dir(save_dir, tag):
+    """Durably publish `<tag>.tmp` as `<tag>`: fsync every file in the
+    staging dir, atomically rename it over the final name, fsync the
+    parent.  A crash at any point leaves either the old `<tag>` (or
+    nothing) or the new one — never a half-written visible checkpoint."""
+    src = staging_dir(save_dir, tag)
+    dst = _ckpt_dir(save_dir, tag)
+    for root, _, files in os.walk(src):
+        for fname in files:
+            _fsync_path(os.path.join(root, fname))
+    _fsync_path(src)
+    trash = None
+    if os.path.exists(dst):
+        # resave of an existing tag: move the old dir aside by RENAME
+        # (microseconds) rather than rmtree'ing it in place (seconds
+        # for a large checkpoint), so the window with no `<tag>`
+        # visible is two renames wide. The trash name carries the
+        # staging suffix so readers and rotation skip it, and a crash
+        # inside the window leaves BOTH complete dirs (`<tag>.old.tmp`
+        # and the fsynced `<tag>.tmp`) recoverable by hand.
+        trash = dst + ".old" + STAGING_SUFFIX
+        if os.path.exists(trash):
+            shutil.rmtree(trash)
+        os.replace(dst, trash)
+    os.replace(src, dst)
+    # stamp COMMIT time on the dir: rotation ranks by mtime, and a
+    # slow writer finishing its file writes late must not make an
+    # earlier-submitted checkpoint look newer than a later one
+    os.utime(dst, None)
+    _fsync_path(save_dir)
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+
+
+def checkpoint_dirs_bit_identical(d1, d2):
+    """True when two checkpoint dirs are byte-identical: same file
+    names, every npz entry equal in dtype and raw bytes, every json
+    manifest equal.  Used by tests and the async_checkpoint bench to
+    prove async and sync saves of the same state match exactly."""
+    f1, f2 = sorted(os.listdir(d1)), sorted(os.listdir(d2))
+    if f1 != f2:
+        return False
+    for name in f1:
+        p1, p2 = os.path.join(d1, name), os.path.join(d2, name)
+        if name.endswith(".npz"):
+            with np.load(p1) as a, np.load(p2) as b:
+                if sorted(a.files) != sorted(b.files):
+                    return False
+                for k in a.files:
+                    if a[k].dtype != b[k].dtype or \
+                            a[k].tobytes() != b[k].tobytes():
+                        return False
+        elif name.endswith(".json"):
+            with open(p1) as fa, open(p2) as fb:
+                if json.load(fa) != json.load(fb):
+                    return False
+    return True
+
+
+def is_checkpoint_dir(path):
+    """True when `path` looks like a completed checkpoint directory
+    (has a model-states file or per-layer files); staging dirs and
+    unrelated directories are excluded."""
+    if not os.path.isdir(path) or is_staging_name(path):
+        return False
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any("model_states" in n or n.startswith("layer_")
+               for n in names)
+
+
+def rotate_checkpoints(save_dir, keep_last, protect=()):
+    """Delete all but the newest `keep_last` checkpoint dirs under
+    `save_dir` (by mtime).  `latest`'s target and `protect` tags are
+    never deleted; `.tmp` staging dirs are never counted or touched.
+    Returns the list of deleted tags."""
+    if not keep_last or keep_last <= 0:
+        return []
+    keep = {str(t) for t in protect}
+    latest = read_latest_tag(save_dir)
+    if latest is not None:
+        keep.add(latest)
+    entries = []
+    for name in os.listdir(save_dir):
+        full = os.path.join(save_dir, name)
+        if is_checkpoint_dir(full):
+            try:
+                entries.append((os.path.getmtime(full), name))
+            except OSError:
+                continue   # vanished concurrently (shared save_dir)
+    entries.sort(reverse=True)
+    deleted = []
+    for _, name in entries[keep_last:]:
+        if name in keep:
+            continue
+        shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+        deleted.append(name)
+    return deleted
+
+
+class AsyncCheckpointWriter:
+    """Background checkpoint writer: one non-daemon thread per save job
+    (the interpreter cannot exit with a write half-done), a bounded
+    in-flight window for backpressure, and error propagation into the
+    training loop at the next submit/wait.
+
+    queue_depth: saves allowed in flight before backpressure engages.
+    queue_policy: "block" — a submit over the depth waits for the
+    oldest job; "drop" — the new save is discarded with a warning
+    (the snapshot is released, nothing is written).
+
+    Jobs may SERIALIZE concurrently (queue_depth >= 2) but COMMIT in
+    submission order via the gate submit() hands to each job — so
+    `latest` and keep_last rotation can never regress to an older save
+    whose writer happened to finish last.
+    """
+
+    def __init__(self, queue_depth=1, queue_policy="block"):
+        assert queue_depth >= 1, queue_depth
+        assert queue_policy in ("block", "drop"), queue_policy
+        self._depth = queue_depth
+        self._policy = queue_policy
+        self._jobs = []          # [(thread, tag)]
+        self._lock = threading.Lock()
+        self._error = None
+        self._seq_next = 0       # submission-order ticket
+        self._commit_turn = 0    # ticket currently allowed to commit
+        self._done_seqs = set()  # finished out-of-order, turn not theirs yet
+        self._commit_cv = threading.Condition()
+
+    def _reap(self):
+        with self._lock:
+            self._jobs = [(t, tag) for t, tag in self._jobs
+                          if t.is_alive()]
+            return list(self._jobs)
+
+    def _raise_pending(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise RuntimeError(
+                "background checkpoint write failed") from err
+
+    def _warn_drop(self, tag):
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            f"async checkpoint '{tag}' dropped: "
+            f"{self._depth} save(s) already in flight "
+            "(checkpoint.queue_policy=drop)")
+
+    def admit(self, tag):
+        """Cheap pre-snapshot check: False when queue_policy="drop"
+        would discard a submit right now, letting the caller skip
+        building the snapshot entirely (for offload engines that is a
+        full host copy of masters and moments).  Under "block" always
+        True — submit() provides the backpressure."""
+        if self._policy != "drop":
+            return True
+        jobs = self._reap()
+        tag = str(tag)
+        # a same-tag job in flight would force submit() to block on it
+        # (shared staging dir) — under "drop" that save drops instead
+        if len(jobs) < self._depth and \
+                not any(jt == tag for _, jt in jobs):
+            return True
+        self._warn_drop(tag)
+        return False
+
+    def _mark_done(self, seq):
+        """Job `seq` no longer needs its commit turn (it committed or
+        died).  Advance the turn across contiguously-finished seqs
+        ONLY — jumping past a still-running earlier job would strand
+        its writer at the gate forever."""
+        with self._commit_cv:
+            if seq < self._commit_turn:
+                return           # turn already consumed (gate path ran)
+            self._done_seqs.add(seq)
+            while self._commit_turn in self._done_seqs:
+                self._done_seqs.discard(self._commit_turn)
+                self._commit_turn += 1
+            self._commit_cv.notify_all()
+
+    def submit(self, fn, tag):
+        """Run fn(commit_gate) on a writer thread; `commit_gate` is a
+        context manager the job must hold around its commit section
+        (rename + `latest` + rotation) — gates open in submission
+        order.  Returns True when the job was accepted, False when
+        queue_policy="drop" rejected it."""
+        self._raise_pending()
+        tag = str(tag)
+        # two writers on one tag would share a `<tag>.tmp` staging dir
+        # (the second rmtrees it out from under the first): serialize
+        # same-tag jobs regardless of queue depth
+        while True:
+            same = [t for t, jt in self._reap() if jt == tag]
+            if not same:
+                break
+            if self._policy == "drop":
+                # blocking on the shared staging dir would violate
+                # drop's never-stall contract
+                self._warn_drop(tag)
+                return False
+            same[0].join()
+        while True:
+            jobs = self._reap()
+            if len(jobs) < self._depth:
+                break
+            if self._policy == "drop":
+                self._warn_drop(tag)
+                return False
+            # join via the snapshot — another thread's concurrent
+            # _reap() may swap self._jobs out from under an index
+            jobs[0][0].join()
+        seq = self._seq_next
+        self._seq_next += 1
+
+        @contextlib.contextmanager
+        def commit_gate():
+            with self._commit_cv:
+                while self._commit_turn != seq:
+                    self._commit_cv.wait()
+            try:
+                yield
+            finally:
+                self._mark_done(seq)
+
+        def run():
+            try:
+                fn(commit_gate)
+            except BaseException as e:  # noqa: BLE001 — must not die silent
+                from deepspeed_tpu.utils.logging import logger
+                import traceback
+                logger.error("async checkpoint write failed:\n"
+                             + traceback.format_exc())
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+            finally:
+                # a job that died before (or without) taking its gate
+                # must still release its turn or later jobs deadlock
+                self._mark_done(seq)
+
+        t = threading.Thread(target=run, daemon=False,
+                             name=f"ckpt-writer-{tag}")
+        with self._lock:
+            self._jobs.append((t, tag))
+        t.start()
+        return True
+
+    def wait(self):
+        """Barrier: block until every in-flight save has committed;
+        re-raise the first writer error, if any."""
+        while True:
+            with self._lock:
+                jobs = list(self._jobs)
+            if not jobs:
+                break
+            for t, _ in jobs:
+                t.join()
+            self._reap()
+        self._raise_pending()
+
+    def pending(self):
+        return len(self._reap())
+
+
+# ----------------------------------------------------------------------
 # latest tag + tag validation
 # ----------------------------------------------------------------------
 def write_latest_tag(save_dir, tag):
+    """Crash-atomic `latest` pointer: write a tmp file, fsync, then
+    os.replace — a reader (or a restart after a kill) sees either the
+    previous tag or the new one, never a torn write."""
     os.makedirs(save_dir, exist_ok=True)
-    with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+    path = os.path.join(save_dir, LATEST_FILE)
+    # unique tmp name: concurrent writer threads (queue_depth >= 2)
+    # must not truncate each other's tmp file between write and rename
+    tmp = (f"{path}.{os.getpid()}.{threading.get_ident()}"
+           f"{STAGING_SUFFIX}")
+    with open(tmp, "w") as f:
         f.write(str(tag))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_path(save_dir)
 
 
 def read_latest_tag(load_dir):
@@ -413,7 +744,15 @@ def read_latest_tag(load_dir):
     if not os.path.exists(path):
         return None
     with open(path, "r") as f:
-        return f.read().strip()
+        tag = f.read().strip()
+    if not tag or is_staging_name(tag):
+        # a staging name can only reach `latest` by hand-editing; treat
+        # it as absent rather than load a possibly half-written dir
+        from deepspeed_tpu.utils.logging import logger
+        logger.warning(
+            f"{path} points at staging entry {tag!r}; ignoring it")
+        return None
+    return tag
 
 
 def validate_checkpoint_tag(tag, fail_on_mismatch=False):

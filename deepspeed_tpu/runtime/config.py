@@ -254,6 +254,43 @@ def get_checkpoint_tag_validation(param_dict):
     return mode
 
 
+def get_checkpoint_async_save(param_dict):
+    block = param_dict.get(C.CHECKPOINT, {})
+    return bool(get_scalar_param(block, C.CHECKPOINT_ASYNC_SAVE,
+                                 C.CHECKPOINT_ASYNC_SAVE_DEFAULT))
+
+
+def get_checkpoint_keep_last(param_dict):
+    block = param_dict.get(C.CHECKPOINT, {})
+    val = get_scalar_param(block, C.CHECKPOINT_KEEP_LAST,
+                           C.CHECKPOINT_KEEP_LAST_DEFAULT)
+    if val < 0:
+        raise DeepSpeedConfigError(
+            f"checkpoint.keep_last must be >= 0 (0 = keep all), got {val}")
+    return int(val)
+
+
+def get_checkpoint_writer_queue_depth(param_dict):
+    block = param_dict.get(C.CHECKPOINT, {})
+    val = get_scalar_param(block, C.CHECKPOINT_WRITER_QUEUE_DEPTH,
+                           C.CHECKPOINT_WRITER_QUEUE_DEPTH_DEFAULT)
+    if val < 1:
+        raise DeepSpeedConfigError(
+            f"checkpoint.writer_queue_depth must be >= 1, got {val}")
+    return int(val)
+
+
+def get_checkpoint_queue_policy(param_dict):
+    block = param_dict.get(C.CHECKPOINT, {})
+    val = get_scalar_param(block, C.CHECKPOINT_QUEUE_POLICY,
+                           C.CHECKPOINT_QUEUE_POLICY_DEFAULT)
+    if val not in C.CHECKPOINT_QUEUE_POLICIES:
+        raise DeepSpeedConfigError(
+            f"checkpoint.queue_policy {val!r} not one of "
+            f"{C.CHECKPOINT_QUEUE_POLICIES}")
+    return val
+
+
 def get_pld_enabled(param_dict):
     if C.PROGRESSIVE_LAYER_DROP in param_dict:
         return get_scalar_param(param_dict[C.PROGRESSIVE_LAYER_DROP],
@@ -481,6 +518,11 @@ class DeepSpeedConfig:
             checkpoint_tag_validation_mode != "Ignore"
         self.checkpoint_tag_validation_fail = \
             checkpoint_tag_validation_mode == "Fail"
+        self.checkpoint_async_save = get_checkpoint_async_save(param_dict)
+        self.checkpoint_keep_last = get_checkpoint_keep_last(param_dict)
+        self.checkpoint_writer_queue_depth = \
+            get_checkpoint_writer_queue_depth(param_dict)
+        self.checkpoint_queue_policy = get_checkpoint_queue_policy(param_dict)
 
     def _batch_assertion(self):
         train_batch = self.train_batch_size

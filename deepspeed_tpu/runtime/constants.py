@@ -151,12 +151,41 @@ PLD_GAMMA = "gamma"
 PLD_GAMMA_DEFAULT = 0.001
 
 #############################################
-# Checkpoint tag validation
+# Checkpoint block: tag validation (reference parity) + the TPU-native
+# zero-stall async save pipeline.
+#   {"checkpoint": {"tag_validation": "Warn", "async_save": true,
+#                   "keep_last": 0, "writer_queue_depth": 1,
+#                   "queue_policy": "block"}}
+# async_save: save_checkpoint costs the train loop only a device-side
+#   snapshot (a jitted copy into fresh buffers the donating step
+#   functions cannot alias, plus host-side copies of the ZeRO-Offload
+#   master/moments/wire state); a background writer thread device_gets
+#   and serializes shards into a `<tag>.tmp` staging dir, fsyncs,
+#   atomically renames to `<tag>`, and updates `latest` last.
+#   `engine.wait_for_checkpoint()` is the barrier (load_checkpoint
+#   calls it implicitly).
+# keep_last: rotation — keep only the newest N checkpoint dirs in
+#   save_dir after each commit (0 = keep all). `latest`'s target is
+#   never deleted.
+# writer_queue_depth: async saves allowed in flight before
+#   backpressure engages.
+# queue_policy: what a save over the depth does — "block" waits for
+#   the oldest in-flight save, "drop" discards the new save with a
+#   warning (save_checkpoint returns False).
 #############################################
 CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = True
+CHECKPOINT_KEEP_LAST = "keep_last"
+CHECKPOINT_KEEP_LAST_DEFAULT = 0
+CHECKPOINT_WRITER_QUEUE_DEPTH = "writer_queue_depth"
+CHECKPOINT_WRITER_QUEUE_DEPTH_DEFAULT = 1
+CHECKPOINT_QUEUE_POLICY = "queue_policy"
+CHECKPOINT_QUEUE_POLICY_DEFAULT = "block"
+CHECKPOINT_QUEUE_POLICIES = ["block", "drop"]
 
 #############################################
 # Pipeline block (dict passed through to PipelineEngine)

@@ -34,6 +34,8 @@ The three-call API (`engine(batch)` / `engine.backward(loss)` /
 (one fused step over all grad-accum microbatches) is the fast path.
 """
 
+import contextlib
+import copy
 import os
 from typing import Any, Callable, NamedTuple, Optional
 
@@ -59,6 +61,7 @@ from deepspeed_tpu.runtime import lr_schedules
 from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
 from deepspeed_tpu.runtime.prefetch import PrefetchLoader
 from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime import checkpoint as ckpt_io
 from deepspeed_tpu.runtime.checkpoint import (save_checkpoint_files,
                                               load_checkpoint_files,
                                               read_latest_tag,
@@ -204,6 +207,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # gating so the hot loop never blocks on device_get (the device
         # counters remain authoritative for checkpointing).
         self._host_steps = 0
+        # async checkpointing: lazily-built jitted snapshot + writer
+        self._ckpt_snapshot_jit = None
+        self._ckpt_writer = None
         self._pending_grads = None
         self._pending_loss = None
         self.losses = None
@@ -464,6 +470,20 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
     def checkpoint_tag_validation_fail(self):
         return self._config.checkpoint_tag_validation_fail
+
+    def checkpoint_async_save(self):
+        """checkpoint.async_save: save_checkpoint costs the train loop
+        only a device snapshot; serialization runs on a writer thread."""
+        return self._config.checkpoint_async_save
+
+    def checkpoint_keep_last(self):
+        return self._config.checkpoint_keep_last
+
+    def checkpoint_writer_queue_depth(self):
+        return self._config.checkpoint_writer_queue_depth
+
+    def checkpoint_queue_policy(self):
+        return self._config.checkpoint_queue_policy
 
     def elasticity_enabled(self):
         return self._config.elasticity_enabled
@@ -1644,7 +1664,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                                     prof_rng, lr, self._keep_prob(),
                                     measure_time=False)
         except Exception as e:  # donated-buffer retrace edge cases
-            logger.warning(f"flops profile failed: {e}")
+            import traceback
+            logger.warning(
+                f"flops profile failed: {e}\n{traceback.format_exc()}")
             return
         prof.stop_profile()
         prof.print_model_profile(
@@ -1674,8 +1696,17 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # ------------------------------------------------------------------
     @property
     def global_steps(self):
-        return int(jax.device_get(self.state.global_steps)) + \
-            int(jax.device_get(self.state.skipped))
+        """Total optimizer steps taken (successful + overflow-skipped).
+        Every step bumps exactly one of the two device counters, so the
+        sum equals the host step mirror EXACTLY (not just optimistically)
+        — under async dispatch it is served from the mirror with no
+        device sync. Otherwise both counters come back in one fused
+        fetch instead of two sequential device_get round trips."""
+        if self._async_dispatch:
+            return self._host_steps
+        gs, sk = jax.device_get((self.state.global_steps,
+                                 self.state.skipped))
+        return int(gs) + int(sk)
 
     @property
     def skipped_steps(self):
@@ -1700,6 +1731,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         layout (identity for tree-layout engines)."""
         return tree
 
+    def _logical_module_tree(self, stored):
+        """Convert a stored-layout fp32/compute module tree into the
+        module's logical tree for serialization (identity here; the
+        pipeline engine unflattens its per-stage flat layout)."""
+        return stored
+
     @property
     def fp32_params(self):
         if self._offload_enabled():
@@ -1717,8 +1754,163 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # ------------------------------------------------------------------
     # checkpointing (ref engine.py:1248-1573; layout preserved)
     # ------------------------------------------------------------------
+    def _ckpt_payload(self, state):
+        """The checkpoint-facing device trees decoded from live state
+        (pad-plan leaves in true unpadded shapes so the checkpoint
+        stays elastic across dp sizes)."""
+        payload = dict(
+            opt_state=self.zero_policy.decode(
+                state.opt_state, self._zero_pad_plan,
+                suffix_match=True),
+            scale=state.scale,
+            global_steps=state.global_steps,
+            skipped=state.skipped)
+        if not self._offload_enabled():
+            if self.mixed_precision:
+                payload["module"] = self.zero_policy.decode(
+                    state.master, self._zero_pad_plan)
+            else:
+                payload["module"] = state.params
+        return payload
+
+    def _build_ckpt_snapshot_fn(self):
+        """Jitted snapshot: decode the checkpoint-facing trees from the
+        live state and copy every leaf into FRESH buffers. The copies
+        cannot alias the state the step functions donate, so training
+        can keep stepping while the writer serializes."""
+        return jax.jit(lambda state: jax.tree_util.tree_map(
+            jnp.copy, self._ckpt_payload(state)))
+
+    def _checkpoint_snapshot(self, client_state, isolate=True):
+        """Phase 1 of save_checkpoint — the only part the train loop
+        pays for: one jitted device-side copy (dispatched async) plus
+        host memcpys of the ZeRO-Offload master/moments/wire state
+        (taken before the next host Adam step can mutate them).
+        isolate=False (inline writes: sync and multi-process saves)
+        skips every copy and serializes straight from live state — the
+        legacy sync path's memory profile; nothing steps while an
+        inline write runs, so aliasing is safe."""
+        if isolate:
+            if self._ckpt_snapshot_jit is None:
+                self._ckpt_snapshot_jit = self._build_ckpt_snapshot_fn()
+            payload = self._ckpt_snapshot_jit(self.state)
+        else:
+            payload = self._ckpt_payload(self.state)
+        snap = dict(
+            # PipelineModule-style models write one file per layer so
+            # the checkpoint reloads onto any stage partitioning
+            # (ref pipe/module.py:536-567)
+            per_layer=hasattr(self.module, "save_state_dict") and
+            hasattr(self.module, "load_state_dir"),
+            payload=payload,
+            # _rng buffers are replaced (never donated) by _next_rng,
+            # so the reference stays valid without a copy
+            rng=self._rng,
+            meta=dict(
+                micro_steps=self.micro_steps,
+                dp_world_size=self.dp_world_size,
+                lr_scheduler=self.lr_scheduler.state_dict()
+                if self.lr_scheduler else None),
+            # deep copy: the caller (and the training loop) may keep
+            # mutating nested client_state values while the background
+            # writer serializes — the snapshot must freeze them now
+            client_state=copy.deepcopy(dict(client_state or {})),
+            # the EFFECTIVE stage (may be capped under pipe flat mode);
+            # checkpoint metadata must describe what actually ran
+            zero_stage=self.zero_policy.stage,
+        )
+        if self._offload_enabled():
+            snap.update(self._offload_checkpoint_snapshot(
+                isolate=isolate))
+            snap["module"] = self._logical_module_tree(snap["module"])
+        else:
+            # logical layout for the writer; the pipe engine's override
+            # slices the snapshot buffers (still async, no host fetch)
+            snap["module"] = self._logical_module_tree(payload["module"])
+        return snap
+
+    def _write_checkpoint(self, save_dir, tag, snap, save_latest,
+                          commit_gate=None):
+        """Phase 2 (runs on the background writer thread under
+        async_save): device_get the snapshot and serialize into a
+        `<tag>.tmp` staging dir, fsync, atomically rename to `<tag>`,
+        update `latest` LAST, then rotate per checkpoint.keep_last.
+        `commit_gate` (from AsyncCheckpointWriter.submit) orders the
+        commit sections of concurrent writers by submission."""
+        multi_proc = jax.process_count() > 1
+
+        def _barrier(phase):
+            # shared-filesystem commit protocol: every process's shard
+            # writes must land before process 0 renames, and no process
+            # may return before the commit is visible
+            if multi_proc:
+                from jax.experimental import multihost_utils
+                multihost_utils.sync_global_devices(f"ckpt_{phase}_{tag}")
+
+        staging = ckpt_io.staging_dir(save_dir, tag)
+        if os.path.exists(staging) and jax.process_index() == 0:
+            import shutil
+            shutil.rmtree(staging)   # stale leftover of a killed save
+        _barrier("begin")
+        os.makedirs(staging, exist_ok=True)
+        payload = snap["payload"]
+        gs, sk = jax.device_get((payload["global_steps"],
+                                 payload["skipped"]))
+        if snap["per_layer"]:
+            # all processes participate (per-layer gathers are
+            # collectives on multi-host shardings); proc 0 writes
+            self.module.save_state_dict(staging, snap["module"])
+        # module/opt_state stay as (possibly sharded) jax arrays: the
+        # writer streams each process's addressable shards to its own
+        # zero_pp_rank files — no host gather (ref engine.py:1522-1531).
+        sd = dict(
+            module={} if snap["per_layer"] else snap["module"],
+            global_steps=int(gs) + int(sk),
+            skipped_steps=int(sk),
+            micro_steps=snap["meta"]["micro_steps"],
+            dp_world_size=snap["meta"]["dp_world_size"],
+            lr_scheduler=snap["meta"]["lr_scheduler"],
+            rng=jax.device_get(snap["rng"]),
+        )
+        sd.update(snap["client_state"])
+        optim_sd = dict(
+            opt_state=payload["opt_state"],
+            scale=jax.device_get(payload["scale"]),
+            zero_stage=snap["zero_stage"],
+        )
+        if "host_adam" in snap:
+            optim_sd["host_adam"] = snap["host_adam"]
+            optim_sd["host_master"] = snap["host_master"]
+            if "offload_wire" in snap:
+                optim_sd["offload_wire"] = snap["offload_wire"]
+        save_checkpoint_files(save_dir, tag, sd, optim_sd,
+                              ckpt_dir=staging)
+        _barrier("staged")
+        with (commit_gate() if commit_gate is not None
+              else contextlib.nullcontext()):
+            if jax.process_index() == 0:
+                ckpt_io.commit_staging_dir(save_dir, tag)
+                if save_latest:
+                    write_latest_tag(save_dir, tag)
+                keep_last = self.checkpoint_keep_last()
+                if keep_last:
+                    deleted = ckpt_io.rotate_checkpoints(
+                        save_dir, keep_last, protect=(tag,))
+                    if deleted:
+                        log_dist("checkpoint rotation removed "
+                                 f"{deleted}", ranks=[0])
+        _barrier("committed")
+        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
-                        save_latest=True):
+                        save_latest=True, async_save=None):
+        """Snapshot-then-write checkpoint save. With
+        checkpoint.async_save (default true) the call returns after the
+        device-side snapshot; a background thread serializes into a
+        staging dir and commits atomically (`wait_for_checkpoint` is
+        the barrier). `async_save` overrides the config per call.
+        Returns False only when checkpoint.queue_policy="drop"
+        discarded the save under backpressure."""
         # the checkpoint must carry the TRUE schedule position, not the
         # optimistic async mirror (drifts across fp16 overflow skips)
         self._sync_scheduler_mirror()
@@ -1727,57 +1919,59 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self.checkpoint_tag_validation_enabled():
             validate_checkpoint_tag(
                 tag, fail_on_mismatch=self.checkpoint_tag_validation_fail())
-        # PipelineModule-style models write one file per layer so the
-        # checkpoint reloads onto any stage partitioning
-        # (ref pipe/module.py:536-567)
-        per_layer = hasattr(self.module, "save_state_dict") and \
-            hasattr(self.module, "load_state_dir")
-        if per_layer:
-            # all processes participate (per-layer gathers are
-            # collectives on multi-host shardings); proc 0 writes
-            self.module.save_state_dict(
-                os.path.join(save_dir, str(tag)), self.fp32_params)
-        # module/opt_state stay as (possibly sharded) jax arrays: the
-        # writer streams each process's addressable shards to its own
-        # zero_pp_rank files — no host gather (ref engine.py:1522-1531).
-        sd = dict(
-            module={} if per_layer else self.fp32_params,
-            global_steps=self.global_steps,
-            skipped_steps=self.skipped_steps,
-            micro_steps=self.micro_steps,
-            dp_world_size=self.dp_world_size,
-            lr_scheduler=self.lr_scheduler.state_dict()
-            if self.lr_scheduler else None,
-            rng=jax.device_get(self._rng),
-        )
-        sd.update(client_state or {})
-        optim_sd = dict(
-            # pad-plan leaves save in true (unpadded) shapes so the
-            # checkpoint stays elastic across dp sizes
-            opt_state=self.zero_policy.decode(
-                self.state.opt_state, self._zero_pad_plan,
-                suffix_match=True),
-            scale=jax.device_get(self.state.scale),
-            # the EFFECTIVE stage (may be capped under pipe flat mode);
-            # checkpoint metadata must describe what actually ran
-            zero_stage=self.zero_policy.stage,
-        )
-        if self._offload_enabled():
-            optim_sd["host_adam"] = self._host_adam.state_dict()
-            optim_sd["host_master"] = self._host_master
-            if self._config.zero_config.offload_wire_compressed():
-                optim_sd["offload_wire"] = \
-                    self._offload_wire_state_dict()
-        save_checkpoint_files(save_dir, tag, sd, optim_sd)
-        if save_latest and jax.process_index() == 0:
-            write_latest_tag(save_dir, tag)
-        log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
-        return True
+        if async_save is None:
+            async_save = self.checkpoint_async_save()
+        if async_save and jax.process_count() > 1:
+            # the shared-dir commit protocol barriers across processes;
+            # running those collectives on a writer thread while the
+            # main thread dispatches step collectives is a deadlock
+            # trap — multi-process saves stay inline
+            log_dist(
+                "checkpoint.async_save: forced off under multi-process "
+                "(the commit barrier is a collective; it must not run "
+                "on a background thread)", ranks=[0])
+            async_save = False
+        if async_save:
+            if self._ckpt_writer is None:
+                self._ckpt_writer = ckpt_io.AsyncCheckpointWriter(
+                    queue_depth=self.checkpoint_writer_queue_depth(),
+                    queue_policy=self.checkpoint_queue_policy())
+            # queue_policy="drop" decides BEFORE the snapshot is built:
+            # a dropped save must not pay the device copy + host
+            # memcpys it is dropping
+            if not self._ckpt_writer.admit(tag):
+                return False
+        snap = self._checkpoint_snapshot(client_state,
+                                         isolate=async_save)
+        if not async_save:
+            # an in-flight async writer may hold this tag's staging dir
+            # or commit `latest` after us — drain it before an inline
+            # write touches the same save_dir (the snapshot above has
+            # already frozen the state this save will contain)
+            self.wait_for_checkpoint()
+            self._write_checkpoint(save_dir, str(tag), snap, save_latest)
+            return True
+        return self._ckpt_writer.submit(
+            lambda commit_gate: self._write_checkpoint(
+                save_dir, str(tag), snap, save_latest,
+                commit_gate=commit_gate),
+            tag)
+
+    def wait_for_checkpoint(self):
+        """Barrier for in-flight async saves: returns once every
+        submitted checkpoint is durably committed (staging dir renamed,
+        `latest` updated) and re-raises the first background write
+        error. load_checkpoint calls this implicitly; call it yourself
+        before shutdown or before reading checkpoints externally."""
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.wait()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_module_strict=True,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True):
+        # a save of the checkpoint being loaded may still be in flight
+        self.wait_for_checkpoint()
         if tag is None:
             tag = read_latest_tag(load_dir)
             if tag is None:
@@ -1929,8 +2123,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 sd.get("global_steps", 0) - sd.get("skipped_steps", 0),
                 jnp.int32))
         self.micro_steps = sd.get("micro_steps", 0)
-        self._host_steps = self.micro_steps // max(
-            1, self.gradient_accumulation_steps())
+        # the checkpoint's global_steps already counts successful +
+        # skipped optimizer steps — deriving from micro_steps instead
+        # would drift whenever the resuming run uses a different
+        # gradient_accumulation_steps than the saving run
+        self._host_steps = int(sd.get("global_steps", 0))
         # re-derive the 1-bit Adam phase: the next train_batch re-checks
         # the restored optimizer count (a load with
         # load_optimizer_states=False resets count=0 and correctly

@@ -427,6 +427,16 @@ class PipelineEngine(DeepSpeedEngine):
             return self._pipe_layout.template(self.state.params)
         return super()._module_ckpt_template()
 
+    def _logical_module_tree(self, stored):
+        """Checkpoint-snapshot hook: the flat-stage layout unflattens
+        into per-layer trees by slicing the SNAPSHOT buffers (async
+        device ops — the save path stays sync-free), so the per-layer
+        writer rides the same snapshot protocol as tree engines."""
+        if getattr(self, "_pipe_flat_mode", False) and \
+                isinstance(stored, dict) and "flat" in stored:
+            return self._pipe_layout.unflatten(stored)
+        return stored
+
     def _module_from_ckpt(self, tree):
         if getattr(self, "_pipe_flat_mode", False):
             return self._pipe_layout.flatten(tree)

@@ -86,6 +86,16 @@ class ZeroOffloadMixin:
         flat, self._offload_unravel = ravel_pytree(params_f32)
         self._host_master = np.asarray(jax.device_get(flat),
                                        dtype=np.float32).copy()
+        # host-side unravel metadata (leaf offsets in ravel_pytree
+        # order): lets the checkpoint writer rebuild the module tree
+        # from the host masters without a device round trip
+        leaves, treedef = jax.tree_util.tree_flatten(params_f32)
+        offs, off = [], 0
+        for leaf in leaves:
+            shape = tuple(np.shape(leaf))
+            offs.append((off, shape))
+            off += int(np.prod(shape))
+        self._offload_np_meta = (treedef, offs)
         p = dict(self._config.optimizer_params or {})
         betas = p.get("betas", (0.9, 0.999))
         self._host_adam = DeepSpeedCPUAdam(
@@ -300,6 +310,43 @@ class ZeroOffloadMixin:
         jnp.zeros would change input shardings and force a recompile)."""
         return jax.device_put(_zeros_like_f32(self.state.acc_grads),
                               self._acc_shardings)
+
+    def _offload_unravel_np(self, flat):
+        """Host twin of `_offload_unravel`: the fp32 module tree as
+        numpy VIEWS of `flat` (ravel_pytree leaf order) — no device
+        round trip on the checkpoint path."""
+        treedef, offs = self._offload_np_meta
+        leaves = [flat[off:off + int(np.prod(shape))].reshape(shape)
+                  for off, shape in offs]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _offload_checkpoint_snapshot(self, isolate=True):
+        """Checkpoint-snapshot half for offload state: copies of
+        everything the next host Adam step mutates in place (masters,
+        moments, wire shadow) plus the wire residual/step counter.
+        Taken synchronously — offload runs a sync loop, and a host
+        memcpy is cheap next to serialization.  isolate=False (inline
+        writes, which finish before the next step can mutate anything)
+        skips the copies and hands out live references — the legacy
+        sync path's memory profile."""
+        master = self._host_master.copy() if isolate else \
+            self._host_master
+        adam_sd = self._host_adam.state_dict()
+        if isolate:
+            # copy every array state_dict returns — a key whitelist
+            # would silently drop state the sync path keeps
+            adam_sd = {k: v.copy() if isinstance(v, np.ndarray) else v
+                       for k, v in adam_sd.items()}
+        snap = {
+            "host_master": master,
+            "host_adam": adam_sd,
+            # module leaves are views of `master` — consistent with it
+            # by construction, and free of extra host RAM
+            "module": self._offload_unravel_np(master),
+        }
+        if self._config.zero_config.offload_wire_compressed():
+            snap["offload_wire"] = self._offload_wire_state_dict()
+        return snap
 
     def _offload_wire_state_dict(self):
         """Wire state that must survive a checkpoint: the error-feedback

@@ -101,6 +101,8 @@ def main():
           flush=True)
     if args.save_dir:
         engine.save_checkpoint(args.save_dir)
+        # commit barrier: the save is async by default
+        engine.wait_for_checkpoint()
 
 
 if __name__ == "__main__":

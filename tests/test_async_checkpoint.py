@@ -1,0 +1,606 @@
+"""Zero-stall async checkpointing (ISSUE 3).
+
+The save path is split into a device-side snapshot (jitted copy into
+fresh buffers the donating step cannot alias, plus host copies of the
+ZeRO-Offload state) and a background writer that serializes into a
+`<tag>.tmp` staging dir, fsyncs, atomically renames, and updates
+`latest` last. These tests pin:
+
+  * async-saved checkpoints are BIT-identical to sync-saved ones, even
+    when training keeps stepping (donating/mutating state) while the
+    writer is still serializing — the snapshot-isolation contract;
+  * crash atomicity: a save killed mid-write leaves the previous
+    `latest` loadable and only a skipped `.tmp` staging dir behind;
+  * backpressure (block/drop per checkpoint.queue_policy), rotation
+    (checkpoint.keep_last), writer-error propagation;
+  * the satellite fixes: fused/mirrored `global_steps`, tag-validation
+    behavior, the legacy-pickle deprecation warning, and the
+    flops-profiler fallback traceback.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu import initialize
+from deepspeed_tpu.runtime import checkpoint as ckpt_io
+from deepspeed_tpu.runtime.mesh import build_mesh
+
+from tests.simple_model import SimpleModel
+
+
+def _make_engine(tmp=None, fp16=True, extra_config=None, seed=0):
+    model = SimpleModel(hidden_dim=16, seed=seed)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": 1,
+        "steps_per_print": 1000,
+        "zero_optimization": {"stage": 2},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+    }
+    if fp16:
+        cfg["fp16"] = {"enabled": True, "initial_scale_power": 8}
+    for k, v in (extra_config or {}).items():
+        cfg[k] = v
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, _, _, _ = initialize(model=model,
+                                 model_parameters=model.params,
+                                 config=cfg, mesh=mesh)
+    return engine
+
+
+def _batch(i, dim=16):
+    rng = np.random.RandomState(i)
+    x = rng.randn(8, dim).astype(np.float32)
+    return {"x": x[None], "y": (x @ np.eye(dim, dtype=np.float32))[None]}
+
+
+def _train(engine, steps, start=0):
+    loss = None
+    for i in range(steps):
+        loss = engine.train_batch(batch=_batch(start + i))
+    return loss
+
+
+def _assert_dirs_bit_identical(d1, d2):
+    assert ckpt_io.checkpoint_dirs_bit_identical(d1, d2), \
+        (sorted(os.listdir(d1)), sorted(os.listdir(d2)))
+
+
+# ----------------------------------------------------------------------
+# tentpole: async commit + snapshot isolation
+# ----------------------------------------------------------------------
+def test_async_save_commits_atomically(tmp_path):
+    engine = _make_engine()
+    _train(engine, 3)
+    assert engine.save_checkpoint(str(tmp_path), tag="t1") is True
+    engine.wait_for_checkpoint()
+    assert os.path.isdir(tmp_path / "t1")
+    assert not os.path.exists(tmp_path / ("t1" + ckpt_io.STAGING_SUFFIX))
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "t1"
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("t1")
+
+
+def test_async_bit_identical_to_sync_under_concurrent_training(tmp_path):
+    """The core contract: a sync save and an async save of the SAME
+    state produce bit-identical files — and training onward (donating
+    every state buffer) while the async writer is still serializing
+    must not change a byte of what lands on disk."""
+    engine = _make_engine()
+    _train(engine, 3)
+    engine.save_checkpoint(str(tmp_path), tag="sync_ref",
+                           async_save=False, save_latest=False)
+    engine.save_checkpoint(str(tmp_path), tag="async_ref",
+                           async_save=True)
+    # the state the saves captured, fetched before training moves on
+    ref_opt = jax.device_get(engine.state.opt_state)
+    # mutate the live state while the writer may still be reading the
+    # snapshot: 4 donating steps invalidate every old state buffer
+    _train(engine, 4, start=100)
+    engine.wait_for_checkpoint()
+    _assert_dirs_bit_identical(str(tmp_path / "sync_ref"),
+                               str(tmp_path / "async_ref"))
+    # and the async checkpoint round-trips into a fresh engine: its
+    # opt_state after load equals the saving engine's at save time
+    engine2 = _make_engine(seed=7)
+    engine2.load_checkpoint(str(tmp_path), tag="async_ref")
+    jax.tree_util.tree_map(
+        lambda ref, loaded: np.testing.assert_array_equal(
+            np.asarray(ref), np.asarray(jax.device_get(loaded))),
+        ref_opt, engine2.state.opt_state)
+
+
+def test_async_bit_identical_offload_wire(tmp_path):
+    """Offload engines snapshot host masters/Adam moments/wire
+    residual+shadow by copy; continuing to train (which mutates the
+    host master IN PLACE) while the writer runs must not leak into the
+    files. Compares every npz entry, including aux/offload_wire/*."""
+    extra = {
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "offload_wire": {"grad_bits": 8,
+                                               "param_bits": 8}},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    }
+    engine = _make_engine(fp16=False, extra_config=extra)
+    _train(engine, 3)
+    engine.save_checkpoint(str(tmp_path), tag="sync_ref",
+                           async_save=False, save_latest=False)
+    engine.save_checkpoint(str(tmp_path), tag="async_ref")
+    _train(engine, 3, start=100)   # in-place host master/moment updates
+    engine.wait_for_checkpoint()
+    _assert_dirs_bit_identical(str(tmp_path / "sync_ref"),
+                               str(tmp_path / "async_ref"))
+    engine2 = _make_engine(fp16=False, extra_config=extra, seed=7)
+    engine2.load_checkpoint(str(tmp_path), tag="async_ref")
+    np.testing.assert_array_equal(engine2._host_master,
+                                  np.load(tmp_path / "sync_ref" /
+                                          "mp_rank_00_model_states.npz")
+                                  ["aux/host_master"])
+
+
+def test_async_per_layer_pipeline_module(tmp_path):
+    """PipelineModule per-layer files ride the same snapshot protocol:
+    layer_NN files written by the background writer match a sync save
+    byte for byte."""
+    import flax.linen as nn
+    from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+    class Dense(nn.Module):
+        feats: int = 16
+
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(self.feats)(x)
+
+    specs = [LayerSpec(Dense, 16) for _ in range(4)]
+    mod = PipelineModule(layers=specs, num_stages=2,
+                         loss_fn=lambda y, lab: jnp.mean(
+                             (y - lab).astype(jnp.float32) ** 2))
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    params = mod.init_params(jax.random.PRNGKey(0), x)
+    mesh = build_mesh({"pipe": 1, "data": 8, "model": 1})
+    engine, _, _, _ = initialize(
+        model=mod, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 1000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        mesh=mesh)
+    engine.train_batch(batch={"x": x, "y": x * 0.5})
+    engine.save_checkpoint(str(tmp_path), tag="sync_ref",
+                           async_save=False, save_latest=False)
+    engine.save_checkpoint(str(tmp_path), tag="async_ref")
+    engine.train_batch(batch={"x": x, "y": x * 0.5})
+    engine.wait_for_checkpoint()
+    assert any(f.startswith("layer_")
+               for f in os.listdir(tmp_path / "async_ref"))
+    _assert_dirs_bit_identical(str(tmp_path / "sync_ref"),
+                               str(tmp_path / "async_ref"))
+
+
+# ----------------------------------------------------------------------
+# backpressure + error propagation
+# ----------------------------------------------------------------------
+def test_writer_backpressure_blocks(tmp_path):
+    engine = _make_engine()   # writer_queue_depth defaults to 1
+    _train(engine, 2)
+    # warm the snapshot jit so the timed first submit below measures
+    # dispatch, not one-time compilation
+    engine.save_checkpoint(str(tmp_path), tag="warm")
+    engine.wait_for_checkpoint()
+    orig = engine._write_checkpoint
+
+    def slow(*a, **k):
+        time.sleep(0.5)
+        return orig(*a, **k)
+
+    engine._write_checkpoint = slow
+    t0 = time.perf_counter()
+    engine.save_checkpoint(str(tmp_path), tag="a")
+    first_submit = time.perf_counter() - t0
+    t1 = time.perf_counter()
+    engine.save_checkpoint(str(tmp_path), tag="b")
+    second_submit = time.perf_counter() - t1
+    engine.wait_for_checkpoint()
+    # first submit returns without waiting for the write; the second
+    # hits the depth-1 queue and blocks until the first commits
+    assert first_submit < 0.4, first_submit
+    assert second_submit >= 0.4, second_submit
+    assert os.path.isdir(tmp_path / "a") and os.path.isdir(tmp_path / "b")
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "b"
+
+
+def test_writer_backpressure_drops(tmp_path):
+    engine = _make_engine(
+        extra_config={"checkpoint": {"queue_policy": "drop"}})
+    _train(engine, 2)
+    orig = engine._write_checkpoint
+    started, release = threading.Event(), threading.Event()
+
+    def gated(*a, **k):
+        started.set()
+        assert release.wait(timeout=30)
+        return orig(*a, **k)
+
+    engine._write_checkpoint = gated
+    assert engine.save_checkpoint(str(tmp_path), tag="a") is True
+    assert started.wait(timeout=10)
+    # second save over the depth: dropped, nothing written for it —
+    # and dropped BEFORE paying for the device+host snapshot
+    with mock.patch.object(engine, "_checkpoint_snapshot") as snap:
+        assert engine.save_checkpoint(str(tmp_path), tag="b") is False
+    assert snap.call_count == 0
+    release.set()
+    engine.wait_for_checkpoint()
+    assert os.path.isdir(tmp_path / "a")
+    assert not os.path.exists(tmp_path / "b")
+    assert not os.path.exists(tmp_path / ("b" + ckpt_io.STAGING_SUFFIX))
+
+
+def test_same_tag_saves_serialize_under_queue_depth_2(tmp_path):
+    """With writer_queue_depth >= 2, a second save to the SAME tag must
+    not race the first writer's staging dir (it would rmtree it out
+    from under the mid-write first job): same-tag jobs serialize."""
+    engine = _make_engine(
+        extra_config={"checkpoint": {"writer_queue_depth": 2}})
+    _train(engine, 2)
+    orig = engine._write_checkpoint
+    started, release = threading.Event(), threading.Event()
+
+    def gated(*a, **k):
+        if not started.is_set():
+            started.set()
+            assert release.wait(timeout=30)
+        return orig(*a, **k)
+
+    engine._write_checkpoint = gated
+    assert engine.save_checkpoint(str(tmp_path), tag="t") is True
+    assert started.wait(timeout=10)
+    threading.Timer(0.5, release.set).start()
+    t0 = time.perf_counter()
+    # submit blocks until the in-flight same-tag job commits
+    assert engine.save_checkpoint(str(tmp_path), tag="t") is True
+    assert time.perf_counter() - t0 >= 0.3
+    engine.wait_for_checkpoint()
+    assert os.path.isdir(tmp_path / "t")
+    assert not os.path.exists(tmp_path / ("t" + ckpt_io.STAGING_SUFFIX))
+    path, _ = engine.load_checkpoint(str(tmp_path), tag="t")
+    assert path is not None
+
+
+def test_commits_happen_in_submission_order(tmp_path):
+    """queue_depth >= 2: even when the FIRST writer is slow, `latest`
+    must end at the last-submitted tag and keep_last rotation must
+    never delete it — concurrent writers commit in submission order."""
+    engine = _make_engine(
+        extra_config={"checkpoint": {"writer_queue_depth": 2,
+                                     "keep_last": 1}})
+    _train(engine, 2)
+    orig = engine._write_checkpoint
+    first = threading.Event()
+
+    def stagger(*a, **k):
+        if not first.is_set():
+            first.set()
+            time.sleep(0.5)   # first job serializes slowly
+        return orig(*a, **k)
+
+    engine._write_checkpoint = stagger
+    assert engine.save_checkpoint(str(tmp_path), tag="older") is True
+    assert engine.save_checkpoint(str(tmp_path), tag="newer") is True
+    engine.wait_for_checkpoint()
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "newer"
+    assert os.path.isdir(tmp_path / "newer")
+    assert not os.path.isdir(tmp_path / "older")   # rotated out
+
+
+def test_later_job_failure_does_not_deadlock_earlier_writer(tmp_path):
+    """queue_depth >= 2: a later-submitted job that dies BEFORE its
+    commit gate must release only its own turn — the earlier, slower
+    writer must still commit (a skipped turn would strand it at the
+    gate forever and hang shutdown)."""
+    engine = _make_engine(
+        extra_config={"checkpoint": {"writer_queue_depth": 2}})
+    _train(engine, 2)
+    orig = engine._write_checkpoint
+
+    def hooked(save_dir, tag, snap, save_latest, **k):
+        if tag == "a":
+            time.sleep(0.5)    # job A serializes slowly
+            return orig(save_dir, tag, snap, save_latest, **k)
+        raise OSError("disk full")   # job B dies before its gate
+
+    engine._write_checkpoint = hooked
+    assert engine.save_checkpoint(str(tmp_path), tag="a") is True
+    assert engine.save_checkpoint(str(tmp_path), tag="b") is True
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        engine.wait_for_checkpoint()   # must raise, not hang
+    assert os.path.isdir(tmp_path / "a")   # A still committed
+
+
+def test_sync_save_drains_inflight_async_writers(tmp_path):
+    """save_checkpoint(async_save=False) with an async writer still in
+    flight must wait for it — otherwise it can rmtree the writer's live
+    staging dir (same tag) or let `latest` regress (older tag commits
+    after the sync save)."""
+    engine = _make_engine()
+    _train(engine, 2)
+    orig = engine._write_checkpoint
+    release = threading.Event()
+
+    def gated(save_dir, tag, snap, save_latest, **k):
+        if tag == "slow":
+            assert release.wait(timeout=30)
+        return orig(save_dir, tag, snap, save_latest, **k)
+
+    engine._write_checkpoint = gated
+    engine.save_checkpoint(str(tmp_path), tag="slow")
+    threading.Timer(0.4, release.set).start()
+    t0 = time.perf_counter()
+    engine.save_checkpoint(str(tmp_path), tag="final", async_save=False)
+    assert time.perf_counter() - t0 >= 0.3   # drained the async writer
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "final"
+    assert os.path.isdir(tmp_path / "slow")
+    assert os.path.isdir(tmp_path / "final")
+
+
+def test_global_steps_mirror_survives_gas_change_across_reload(tmp_path):
+    """The restored host step mirror comes from the checkpoint's own
+    global_steps — rederiving it from micro_steps would double it when
+    resuming with a smaller gradient_accumulation_steps."""
+    eng_a = _make_engine(
+        extra_config={"gradient_accumulation_steps": 2})
+    x = np.random.RandomState(0).randn(2, 8, 16).astype(np.float32)
+    for _ in range(2):
+        eng_a.train_batch(batch={"x": x, "y": x})
+    assert eng_a.global_steps == 2
+    eng_a.save_checkpoint(str(tmp_path), tag="t")
+    eng_a.wait_for_checkpoint()
+    eng_b = _make_engine(seed=7)   # gas=1
+    eng_b.load_checkpoint(str(tmp_path), tag="t")
+    assert eng_b.global_steps == 2   # micro_steps//gas would say 4
+
+
+def test_resave_existing_tag_commits_and_cleans_up(tmp_path):
+    """Re-saving an existing tag replaces it via rename-aside (no
+    rmtree of the live checkpoint before the new one is visible) and
+    leaves no staging/trash dirs behind."""
+    engine = _make_engine()
+    _train(engine, 1)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    engine.wait_for_checkpoint()
+    _train(engine, 2, start=50)
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    engine.wait_for_checkpoint()
+    assert sorted(os.listdir(tmp_path)) == ["latest", "t"]
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("t")
+
+
+def test_client_state_snapshot_isolated(tmp_path):
+    """Nested client_state values mutated after save_checkpoint returns
+    (while the writer is still serializing) must not leak into the
+    checkpoint — the snapshot deep-copies them."""
+    engine = _make_engine()
+    _train(engine, 1)
+    orig = engine._write_checkpoint
+    gate = threading.Event()
+
+    def slow(*a, **k):
+        assert gate.wait(timeout=30)
+        return orig(*a, **k)
+
+    engine._write_checkpoint = slow
+    state = {"metrics": {"acc": 1}}
+    engine.save_checkpoint(str(tmp_path), tag="t", client_state=state)
+    state["metrics"]["acc"] = 999   # mutate while the writer waits
+    gate.set()
+    engine.wait_for_checkpoint()
+    sd, _ = ckpt_io.load_checkpoint_files(str(tmp_path), "t")
+    assert sd["metrics"] == {"acc": 1}
+
+
+def test_writer_error_reraised_at_barrier(tmp_path):
+    engine = _make_engine()
+    _train(engine, 1)
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    engine._write_checkpoint = boom
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    with pytest.raises(RuntimeError, match="checkpoint write failed"):
+        engine.wait_for_checkpoint()
+    # the error is consumed; the writer is usable again
+    engine.wait_for_checkpoint()
+
+
+# ----------------------------------------------------------------------
+# crash atomicity (satellite 1)
+# ----------------------------------------------------------------------
+def test_kill_mid_save_previous_latest_still_loads(tmp_path):
+    """A process killed between writing the staging files and the
+    atomic commit must leave `latest` -> the previous complete tag and
+    only a `.tmp` dir for the torn save."""
+    child = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+import numpy as np
+from deepspeed_tpu import initialize
+from deepspeed_tpu.runtime import checkpoint as ckpt_io
+from deepspeed_tpu.runtime.mesh import build_mesh
+from tests.simple_model import SimpleModel
+
+model = SimpleModel(hidden_dim=16, seed=0)
+engine, _, _, _ = initialize(
+    model=model, model_parameters=model.params,
+    config={{"train_micro_batch_size_per_gpu": 8,
+            "optimizer": {{"type": "Adam", "params": {{"lr": 1e-3}}}}}},
+    mesh=build_mesh({{"pipe": 1, "data": 1, "model": 1}}))
+rng = np.random.RandomState(0)
+x = rng.randn(8, 16).astype(np.float32)
+engine.train_batch(batch={{"x": x[None], "y": x[None]}})
+engine.save_checkpoint({str(tmp_path)!r}, tag="good", async_save=False)
+# SIGKILL-equivalent at the commit point of the NEXT save: staging
+# files exist, the rename and the latest update never happen
+ckpt_io.commit_staging_dir = lambda *a, **k: os._exit(9)
+engine.save_checkpoint({str(tmp_path)!r}, tag="bad", async_save=False)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run([sys.executable, "-c", child], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 9, proc.stderr[-2000:]
+    # torn save visible only as staging; previous tag + latest intact
+    assert os.path.isdir(tmp_path / "good")
+    assert not os.path.exists(tmp_path / "bad")
+    assert os.path.isdir(tmp_path / ("bad" + ckpt_io.STAGING_SUFFIX))
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "good"
+
+    # elastic reload: saved on the child's 1-device mesh, loaded onto
+    # this process's 8-device data mesh
+    model = SimpleModel(hidden_dim=16, seed=0)
+    engine, _, _, _ = initialize(
+        model=model, model_parameters=model.params,
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+        mesh=build_mesh({"pipe": 1, "data": 8, "model": 1}))
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None and path.endswith("good")
+
+
+def test_interrupted_save_tag_raises_clear_error(tmp_path):
+    os.makedirs(tmp_path / ("t" + ckpt_io.STAGING_SUFFIX))
+    with pytest.raises(FileNotFoundError, match="interrupted save"):
+        ckpt_io.load_checkpoint_flat(str(tmp_path), "t")
+
+
+def test_read_latest_tag_skips_staging_names(tmp_path):
+    (tmp_path / "latest").write_text("t" + ckpt_io.STAGING_SUFFIX)
+    assert ckpt_io.read_latest_tag(str(tmp_path)) is None
+    ckpt_io.write_latest_tag(str(tmp_path), "real")
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "real"
+    # atomic write leaves no tmp pointer behind
+    assert not os.path.exists(tmp_path / ("latest"
+                                          + ckpt_io.STAGING_SUFFIX))
+
+
+# ----------------------------------------------------------------------
+# rotation
+# ----------------------------------------------------------------------
+def test_keep_last_rotation(tmp_path):
+    engine = _make_engine(extra_config={"checkpoint": {"keep_last": 2}})
+    _train(engine, 1)
+    for i in range(3):
+        engine.save_checkpoint(str(tmp_path), tag=f"t{i}")
+        engine.wait_for_checkpoint()
+        time.sleep(0.05)   # distinct mtimes on coarse filesystems
+    dirs = sorted(d for d in os.listdir(tmp_path)
+                  if os.path.isdir(tmp_path / d))
+    assert dirs == ["t1", "t2"], dirs
+    assert ckpt_io.read_latest_tag(str(tmp_path)) == "t2"
+    # rotation never deletes latest's target even when it is old
+    assert engine.load_checkpoint(str(tmp_path))[0].endswith("t2")
+
+
+# ----------------------------------------------------------------------
+# satellite: fused / mirrored global_steps
+# ----------------------------------------------------------------------
+def test_global_steps_served_from_mirror_under_async_dispatch():
+    engine = _make_engine()
+    assert engine.async_dispatch_enabled()
+    _train(engine, 3)
+    with mock.patch.object(jax, "device_get",
+                           side_effect=jax.device_get) as dg:
+        assert engine.global_steps == 3
+    assert dg.call_count == 0
+    # the mirror agrees with the device counters at a fence
+    gs, sk = jax.device_get((engine.state.global_steps,
+                             engine.state.skipped))
+    assert int(gs) + int(sk) == 3
+
+
+def test_global_steps_single_fused_fetch_in_sync_mode():
+    engine = _make_engine(
+        extra_config={"async_dispatch": {"enabled": False}})
+    assert not engine.async_dispatch_enabled()
+    _train(engine, 2)
+    with mock.patch.object(jax, "device_get",
+                           side_effect=jax.device_get) as dg:
+        assert engine.global_steps == 2
+    assert dg.call_count == 1   # one fused (global_steps, skipped) fetch
+
+
+# ----------------------------------------------------------------------
+# satellite: tag validation + legacy pickle warning
+# ----------------------------------------------------------------------
+def test_validate_checkpoint_tag_single_process_passes():
+    assert ckpt_io.validate_checkpoint_tag("step5") is True
+    assert ckpt_io.validate_checkpoint_tag("step5",
+                                           fail_on_mismatch=True) is True
+
+
+def test_validate_checkpoint_tag_mismatch(monkeypatch):
+    from jax.experimental import multihost_utils
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(
+        multihost_utils, "process_allgather",
+        lambda digest: np.stack([digest, digest + 1]))
+    with pytest.raises(ValueError,
+                       match="not consistent across all processes"):
+        ckpt_io.validate_checkpoint_tag("tag_rank0", fail_on_mismatch=True)
+    # warn mode: returns False and logs instead of raising
+    from deepspeed_tpu.utils.logging import logger
+    with mock.patch.object(logger, "warning") as warn:
+        assert ckpt_io.validate_checkpoint_tag("tag_rank0") is False
+    assert warn.called
+
+
+def test_legacy_pickle_load_emits_deprecation_warning(tmp_path):
+    import pickle
+    d = tmp_path / "old"
+    d.mkdir()
+    with open(d / "mp_rank_00_model_states.pt", "wb") as f:
+        pickle.dump({"module": {"w": np.zeros(2, np.float32)},
+                     "global_steps": 1}, f)
+    from deepspeed_tpu.utils.logging import logger
+    with mock.patch.object(logger, "warning") as warn:
+        sd, optim_sd = ckpt_io.load_checkpoint_files(str(tmp_path), "old")
+    assert any("legacy" in str(c.args[0]) and "pickle" in str(c.args[0])
+               for c in warn.call_args_list)
+    assert "module" in sd and optim_sd is None
+
+
+# ----------------------------------------------------------------------
+# satellite: flops-profiler fallback logs the full traceback
+# ----------------------------------------------------------------------
+def test_flops_profiler_fallback_logs_traceback():
+    from deepspeed_tpu.profiling.flops_profiler import FlopsProfiler
+    from deepspeed_tpu.utils.logging import logger
+    engine = _make_engine()
+    _train(engine, 1)
+
+    def boom(self, *a, **k):
+        raise ValueError("donated-buffer retrace boom")
+
+    with mock.patch.object(FlopsProfiler, "profile_jitted", boom), \
+            mock.patch.object(logger, "warning") as warn:
+        engine._profile_fused_step(_batch(0), None)
+    msgs = [str(c.args[0]) for c in warn.call_args_list]
+    assert any("flops profile failed" in m and "Traceback" in m
+               and "donated-buffer retrace boom" in m for m in msgs)

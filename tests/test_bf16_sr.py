@@ -151,6 +151,7 @@ def test_sr_mode_gas2_checkpoint_resume(tmp_path):
     for _ in range(2):
         engine.train_batch(batch={"input_ids": ids})
     engine.save_checkpoint(str(tmp_path), tag="t2")
+    engine.wait_for_checkpoint()
     ref_next = float(jax.device_get(
         engine.train_batch(batch={"input_ids": ids})))
 
@@ -215,6 +216,7 @@ def test_sr_mode_checkpoint_roundtrip(tmp_path):
     for _ in range(3):
         engine.train_batch(batch={"input_ids": ids[None]})
     engine.save_checkpoint(str(tmp_path), tag="t3")
+    engine.wait_for_checkpoint()
     ref_next = float(jax.device_get(
         engine.train_batch(batch={"input_ids": ids[None]})))
 

@@ -109,6 +109,7 @@ def test_offload_checkpoint_roundtrip(tmp_ckpt_dir):
         engine.train_batch(batch={"input_ids": ids[None]})
     master_before = engine._host_master.copy()
     engine.save_checkpoint(tmp_ckpt_dir)
+    engine.wait_for_checkpoint()
     engine2, _ = _gpt2_engine(offload=True)
     engine2.load_checkpoint(tmp_ckpt_dir)
     np.testing.assert_allclose(engine2._host_master, master_before)

@@ -219,6 +219,7 @@ def test_checkpoint_roundtrip(tmp_ckpt_dir):
         model=model, model_parameters=model.params, config=cfg)
     train_steps(engine, 5)
     engine.save_checkpoint(tmp_ckpt_dir, client_state={"my_key": 123})
+    engine.wait_for_checkpoint()
 
     model2 = SimpleModel(hidden_dim=16, seed=99)
     engine2, _, _, _ = deepspeed_tpu.initialize(
@@ -244,6 +245,7 @@ def test_checkpoint_latest_tag(tmp_ckpt_dir):
     train_steps(engine, 2, dim=8)
     engine.save_checkpoint(tmp_ckpt_dir, tag="tag_a")
     engine.save_checkpoint(tmp_ckpt_dir, tag="tag_b")
+    engine.wait_for_checkpoint()
     from deepspeed_tpu.runtime.checkpoint import read_latest_tag
     assert read_latest_tag(tmp_ckpt_dir) == "tag_b"
 
@@ -317,6 +319,7 @@ def test_checkpoint_restores_lr_scheduler_state(tmp_ckpt_dir):
     saved_lr = sch.get_lr()[0]
     assert 0 < saved_lr < 1e-2    # mid-warmup
     engine.save_checkpoint(tmp_ckpt_dir)
+    engine.wait_for_checkpoint()
 
     model2 = SimpleModel(hidden_dim=16, seed=3)
     engine2, _, _, sch2 = deepspeed_tpu.initialize(

@@ -193,6 +193,7 @@ _ZERO2_SCRIPT = textwrap.dedent("""
         for i in range(5):
             engine.train_batch(batch=batch)
         engine.save_checkpoint(CKPT, tag="ms")
+        engine.wait_for_checkpoint()
         # module_state_dict fetches non-fully-addressable arrays via
         # process_allgather (engine._fetch_to_host) — checksum must
         # agree across ranks

@@ -259,6 +259,7 @@ def test_wire_checkpoint_roundtrip(tmp_ckpt_dir):
     res = np.asarray(jax.device_get(e._offload_grad_residual))
     shadow = e._offload_param_shadow.copy()
     e.save_checkpoint(tmp_ckpt_dir)
+    e.wait_for_checkpoint()
 
     e2, _ = _engine(wire={"grad_bits": 1, "param_bits": 8})
     e2.load_checkpoint(tmp_ckpt_dir)
@@ -277,6 +278,7 @@ def test_wire_engine_loads_other_wire_config_checkpoint(tmp_ckpt_dir):
     e, ids = _engine(wire={"grad_bits": 8, "param_bits": 8})
     _run(e, ids, 2)
     e.save_checkpoint(tmp_ckpt_dir)
+    e.wait_for_checkpoint()
 
     e2, _ = _engine(wire={"grad_bits": 1})
     _run(e2, ids, 2)   # accumulate a nonzero residual pre-load
@@ -296,6 +298,7 @@ def test_wire_engine_loads_wireless_checkpoint(tmp_ckpt_dir):
     _run(e, ids, 2)
     master = e._host_master.copy()
     e.save_checkpoint(tmp_ckpt_dir)
+    e.wait_for_checkpoint()
 
     e2, _ = _engine(wire={"grad_bits": 1, "param_bits": 8})
     e2.load_checkpoint(tmp_ckpt_dir)

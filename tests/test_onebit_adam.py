@@ -190,6 +190,7 @@ def test_resume_without_optimizer_states_rewarms(tmp_path):
     engine, _ = run_train(onebit_config(freeze_step=3), steps=6)
     assert engine._onebit_compressed_active
     engine.save_checkpoint(str(tmp_path))
+    engine.wait_for_checkpoint()
 
     model = SimpleModel(hidden_dim=DIM)
     engine2, _, _, _ = deepspeed_tpu.initialize(

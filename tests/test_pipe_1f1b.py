@@ -363,6 +363,7 @@ def test_1f1b_flat_checkpoint_roundtrip(tmp_path):
     for i in range(3):
         engine.train_batch(batch=full_batch(4, seed=i))
     engine.save_checkpoint(str(tmp_path), tag="t3")
+    engine.wait_for_checkpoint()
     ref_next = float(jax.device_get(
         engine.train_batch(batch=full_batch(4, seed=9))))
 

@@ -50,6 +50,7 @@ def test_save_writes_shard_files_no_pickle(tmp_path):
     engine, ids = _make_engine(mesh, stage=2)
     _train(engine, ids)
     engine.save_checkpoint(str(tmp_path), tag="t1")
+    engine.wait_for_checkpoint()
 
     d = str(tmp_path / "t1")
     files = os.listdir(d)
@@ -72,6 +73,7 @@ def test_roundtrip_same_mesh(tmp_path):
     m_before = jax.device_get(
         jax.tree_util.tree_leaves(engine.state.opt_state))
     engine.save_checkpoint(str(tmp_path), tag="rt")
+    engine.wait_for_checkpoint()
 
     engine2, _ = _make_engine(mesh, stage=2)
     engine2.load_checkpoint(str(tmp_path), tag="rt")
@@ -97,6 +99,7 @@ def test_elastic_reload_different_mesh(tmp_path):
     _train(engine, ids)
     loss_before = _train(engine, ids, steps=1)
     engine.save_checkpoint(str(tmp_path), tag="elastic")
+    engine.wait_for_checkpoint()
 
     mesh42 = build_mesh({"pipe": 1, "data": 4, "model": 2})
     engine2, _ = _make_engine(mesh42, stage=2)
@@ -186,6 +189,7 @@ def test_format_version_written_and_future_rejected(tmp_path):
     engine, ids = _make_engine(mesh, stage=2)
     engine.train_batch(batch={"input_ids": ids[None]})
     engine.save_checkpoint(str(tmp_path), tag="v")
+    engine.wait_for_checkpoint()
 
     # exact main-manifest name: a bare '*model_states.json' would also
     # match shard-bucket manifests, which the loader never version-checks
@@ -210,6 +214,7 @@ def test_missing_shard_file_detected(tmp_path):
     engine, ids = _make_engine(mesh, stage=2)
     engine.train_batch(batch={"input_ids": ids[None]})
     engine.save_checkpoint(str(tmp_path), tag="v")
+    engine.wait_for_checkpoint()
 
     shard = _find_one("zero_pp_rank_1_*.npz", tmp_path)
     os.remove(shard)
@@ -225,6 +230,7 @@ def test_truncated_shard_file_detected(tmp_path):
     engine, ids = _make_engine(mesh, stage=2)
     engine.train_batch(batch={"input_ids": ids[None]})
     engine.save_checkpoint(str(tmp_path), tag="v")
+    engine.wait_for_checkpoint()
 
     shard = _find_one("zero_pp_rank_0_*.npz", tmp_path)
     data = open(shard, "rb").read()

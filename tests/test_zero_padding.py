@@ -125,6 +125,7 @@ def test_checkpoint_elastic_across_padding(tmp_path):
         engine.train_batch(batch=make_batch(i))
     ref = jax.device_get(engine.fp32_params)
     engine.save_checkpoint(str(tmp_path))
+    engine.wait_for_checkpoint()
 
     for stage in (2, 0):
         e2 = make_engine(stage=stage)
