@@ -247,7 +247,19 @@ def bench_gpt2_350m():
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 1e-4, "weight_decay": 0.01}},
         })
-    return {"tokens_per_sec_per_chip": round(tps, 1), "mfu": round(mfu, 4)}
+    out = {"tokens_per_sec_per_chip": round(tps, 1), "mfu": round(mfu, 4)}
+    try:
+        from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
+        import jax.numpy as jnp
+        cfg = gpt2_config("gpt2-350m", n_positions=1024, dropout=0.0,
+                          dtype=jnp.bfloat16, remat=True,
+                          remat_policy="dots_with_no_batch_dims_saveable")
+        out["per_fusion_top3"] = _model_fusion_sinks(
+            GPT2ForCausalLM(cfg),
+            {"input_ids": np.zeros((16, 1024), np.int32)})
+    except Exception as e:
+        out["per_fusion_top3"] = f"unavailable: {type(e).__name__}: {e}"
+    return out
 
 
 def bench_gpt2_cpu_smoke():
@@ -320,10 +332,20 @@ def bench_bert_large():
     samples_per_sec = batch * gas * steps / dt / len(jax.devices())
     tflops = samples_per_sec * seq * 6.0 * n_params / 1e12
     peak = _peak_flops(jax.devices()[0])
-    return {"samples_per_sec_per_chip": round(samples_per_sec, 1),
-            "tflops_per_chip": round(tflops, 1),
-            "mfu": round(tflops * 1e12 / peak, 4) if peak else 0.0,
-            "vs_v100_published": round(samples_per_sec / 272.0, 2)}
+    out = {"samples_per_sec_per_chip": round(samples_per_sec, 1),
+           "tflops_per_chip": round(tflops, 1),
+           "mfu": round(tflops * 1e12 / peak, 4) if peak else 0.0,
+           "vs_v100_published": round(samples_per_sec / 272.0, 2)}
+    try:
+        # per-fusion time breakdown (HLO-cost-analysis roofline) of one
+        # microbatch's fwd+bwd — the table that flagged the fp32 MLM
+        # head as the top sink (fix: mlm_head_in_compute_dtype; A/B in
+        # the bert_mlm_head_dtype leg)
+        one = {k: v[0] for k, v in make_batch(0).items()}
+        out["per_fusion_top3"] = _model_fusion_sinks(model, one)
+    except Exception as e:
+        out["per_fusion_top3"] = f"unavailable: {type(e).__name__}: {e}"
+    return out
 
 
 def bench_sparse_16k():
@@ -631,6 +653,163 @@ def bench_ring_attention():
     out["flash_32k"] = {"fwd_bwd_ms": round(t32 * 1e3, 2),
                         "tokens_per_sec": round(t / t32, 1)}
     return out
+
+
+def _model_fusion_sinks(model, example_batch, top=3):
+    """Top-N per-fusion time sinks of the model's jitted fwd+bwd at the
+    bench shape (profiler HLO-cost-analysis roofline). Compile-only:
+    params are abstract (eval_shape), nothing executes — the table says
+    WHERE the step's time goes, the throughput numbers say how much."""
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (
+        top_fusion_sinks)
+    params = jax.eval_shape(lambda r: model.init(r, example_batch),
+                            jax.random.PRNGKey(0))
+
+    def loss(p):
+        return model.loss_fn(p, example_batch, deterministic=True)
+
+    peak = _peak_flops(jax.devices()[0])
+    return top_fusion_sinks(jax.grad(loss), params, top=top,
+                            peak_flops=peak if peak else None)
+
+
+def bench_flash_head_packing():
+    """Head-packing A/B: the packed flash kernel processes TWO d=64
+    heads per grid step (block-diagonal K/V, [bq,128]x[128,2bk] score
+    matmuls) so every contraction runs at the MXU's native K=128
+    instead of half-starved K=64 (flash_attention.py docstring).
+    Packed and unpacked kernels are timed fwd+bwd in INTERLEAVED
+    best-of-N windows (same throttle regime), plus a forward parity
+    check — the zero lanes contribute exact +0, so the two kernels
+    agree to fp32 roundoff."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        flash_attention
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        # flagship-adjacent shape (gpt2-1.5b is h=25 d=64 t=1024; b*h
+        # rounds to an even row count via the kernel's one-row pad)
+        b, h, t, d, dtype, interpret, inner = \
+            8, 16, 1024, 64, jnp.bfloat16, None, 8
+    else:
+        # CPU interpreter: same kernel logic; the packed grid has half
+        # the row-blocks, which is the dominant term in interpret mode
+        b, h, t, d, dtype, interpret, inner = \
+            4, 8, 256, 64, jnp.float32, True, 2
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), dtype)
+
+    def make(hp):
+        f = jax.jit(lambda q: jax.grad(lambda q: flash_attention(
+            q, q, q, causal=True, interpret=interpret, head_packing=hp)
+            .astype(jnp.float32).sum())(q).sum())
+        _sync(f(q))   # compile + warm
+        return f
+
+    f_packed, f_unpacked = make("packed"), make("off")
+
+    def window(f):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            r = f(q)
+        _sync(r)
+        return (time.perf_counter() - t0) / inner
+
+    best = {"packed": float("inf"), "unpacked": float("inf")}
+    for _ in range(4):                      # interleaved A/B windows
+        best["packed"] = min(best["packed"], window(f_packed))
+        best["unpacked"] = min(best["unpacked"], window(f_unpacked))
+
+    o_p = flash_attention(q, q, q, causal=True, interpret=interpret,
+                          head_packing="packed")
+    o_u = flash_attention(q, q, q, causal=True, interpret=interpret,
+                          head_packing="off")
+    maxdiff = float(jnp.abs(o_p.astype(jnp.float32) -
+                            o_u.astype(jnp.float32)).max())
+    speedup = best["unpacked"] / best["packed"]
+    return {"shape": f"b{b} h{h} t{t} d{d} {np.dtype(dtype).name}"
+                     + (" interpret" if interpret else ""),
+            "packed_fwd_bwd_ms": round(best["packed"] * 1e3, 2),
+            "unpacked_fwd_bwd_ms": round(best["unpacked"] * 1e3, 2),
+            "packed_speedup": round(speedup, 3),
+            "packed_faster": bool(speedup >= 1.0),
+            "fwd_max_abs_diff": maxdiff}
+
+
+def bench_bert_mlm_head_dtype():
+    """A/B of the BERT-large seq-128 top-sink fix: the MLM head
+    (transform + [hidden, vocab] decoder) matmuls in the compute dtype
+    vs the old fp32. The decoder is ~10% of the step's flops; in fp32
+    it runs at a fraction of the MXU's bf16 rate and the per-fusion
+    table ranked it the top sink of the seq-128 step (seq-128 BERT is
+    MLP/head-dominated — attention is tiny at T=128). Interleaved
+    best-of-N fwd+bwd windows; loss math is fp32 in both arms (the CE
+    upcasts logits), so this is a matmul-precision A/B only.
+
+    The A arm is the SHIPPED default ("auto": compute dtype on real
+    TPU, fp32 on CPU — CPU XLA emulates bf16 dots slower than fp32),
+    the B arm forces fp32: on TPU this measures the fix, on CPU it
+    measures noise between two identical programs (the honest "the fix
+    does not regress CPU" statement)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.bert import BertForPreTrainingLM, bert_config
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        name, batch, seq, inner = "bert-large", 16, 128, 4
+    else:
+        name, batch, seq, inner = "bert-base", 4, 128, 2
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 1000, (batch, seq)).astype(np.int32)
+    labels = np.where(r.random((batch, seq)) < 0.15, ids, -100) \
+        .astype(np.int32)
+    ex = {"input_ids": ids, "masked_lm_labels": labels,
+          "next_sentence_label": r.integers(0, 2, (batch,))
+          .astype(np.int32)}
+
+    def make(head_in_compute_dtype):
+        cfg = bert_config(name, max_position_embeddings=seq,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0, bf16=True,
+                          mlm_head_in_compute_dtype=head_in_compute_dtype)
+        model = BertForPreTrainingLM(cfg)
+        params = jax.jit(lambda rr: model.init(rr, ex))(
+            jax.random.PRNGKey(0))
+
+        def loss(p):
+            return model.loss_fn(p, ex, deterministic=True)
+
+        g = jax.jit(lambda p: jax.tree_util.tree_reduce(
+            lambda a, l: a + l.astype(jnp.float32).sum(),
+            jax.grad(loss)(p), jnp.float32(0.0)))
+        _sync(g(params))
+        return g, params
+
+    g_fix, p_fix = make("auto")
+    g_f32, p_f32 = make(False)
+
+    def window(g, p):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = g(p)
+        _sync(out)
+        return (time.perf_counter() - t0) / inner
+
+    best = {"fix": float("inf"), "f32": float("inf")}
+    for _ in range(4):
+        best["fix"] = min(best["fix"], window(g_fix, p_fix))
+        best["f32"] = min(best["f32"], window(g_f32, p_f32))
+    speedup = best["f32"] / best["fix"]
+    return {"model": name, "seq": seq, "batch": batch,
+            "head_dtype_auto_resolves_to":
+                "bf16" if on_tpu else "fp32",
+            "fixed_head_ms": round(best["fix"] * 1e3, 2),
+            "fp32_head_ms": round(best["f32"] * 1e3, 2),
+            "fixed_speedup": round(speedup, 3),
+            # 3% tolerance: on CPU the arms are identical programs
+            # (auto -> fp32), so only timing noise separates them
+            "regressed": bool(speedup < 0.97)}
 
 
 def bench_pipe_interp_vs_spmd():
@@ -1213,6 +1392,8 @@ BENCH_LEGS = {
     "async_dispatch": bench_async_dispatch,
     "gpt2_350m": bench_gpt2_350m,
     "bert_large_fused_seq128": bench_bert_large,
+    "flash_head_packing": bench_flash_head_packing,
+    "bert_mlm_head_dtype": bench_bert_mlm_head_dtype,
     "sparse_attention_16k": bench_sparse_16k,
     "ring_attention_per_step": bench_ring_attention,
     "zero_offload_real_step": bench_offload_real_step,

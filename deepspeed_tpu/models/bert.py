@@ -39,6 +39,24 @@ class BertConfig:
     normalize_invertible: bool = False
     gelu_checkpoint: bool = False
     attn_dropout_checkpoint: bool = False
+    # d=64 head packing in the flash kernel ("auto"|"packed"|"off");
+    # forwarded to DeepSpeedTransformerConfig.head_packing. bert-large
+    # is d=64 (1024/16), so "auto" packs two heads per grid step into
+    # K=128 contractions on real TPU.
+    attention_head_packing: str = "auto"
+    # Run the MLM head (transform + vocab decoder) matmuls in the
+    # compute dtype instead of fp32. The [hidden, vocab] decoder
+    # projection is ~10% of the model's flops; in fp32 it runs at a
+    # fraction of the MXU's bf16 rate and was the top per-fusion time
+    # sink of the seq-128 pretraining step (bench.py
+    # bert_mlm_head_dtype leg). LayerNorm stats stay fp32 and the loss
+    # upcasts logits to fp32, so only the matmul precision changes —
+    # the same contract as every encoder-layer matmul. "auto" enables
+    # it on real TPU only (CPU XLA emulates bf16 dots ~11% SLOWER than
+    # fp32, measured in the bench leg); True/False force. Resolved at
+    # trace time off jax.default_backend() — same AOT caveat as the
+    # flash kernel's interpret auto-select.
+    mlm_head_in_compute_dtype: Any = "auto"
 
 
 BERT_SIZES = {
@@ -76,6 +94,7 @@ def _ds_layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
         gelu_checkpoint=cfg.gelu_checkpoint,
         attn_dropout_checkpoint=cfg.attn_dropout_checkpoint,
         layer_norm_eps=cfg.layer_norm_eps,
+        head_packing=cfg.attention_head_packing,
         training=True)
 
 
@@ -166,13 +185,26 @@ class BertForPreTraining(nn.Module):
         sequence_output, pooled = BertModel(cfg, name="bert")(
             input_ids, attention_mask, token_type_ids, deterministic)
         # MLM head: transform + LN + decoder tied to nothing (separate
-        # projection keeps the head simple; tying is a config choice)
-        x = nn.Dense(cfg.hidden_size, name="transform")(
-            sequence_output.astype(jnp.float32))
+        # projection keeps the head simple; tying is a config choice).
+        # The head matmuls run in the compute dtype (see
+        # mlm_head_in_compute_dtype): the [hidden, vocab] decoder is
+        # ~10% of the step's flops and in fp32 it was the top
+        # per-fusion time sink. LN stats stay fp32; the loss upcasts
+        # logits to fp32.
+        head_compute = cfg.mlm_head_in_compute_dtype
+        if head_compute == "auto":
+            head_compute = jax.default_backend() == "tpu"
+        head_dtype = jnp.float32
+        if head_compute:
+            head_dtype = (jnp.float16 if cfg.fp16 else
+                          jnp.bfloat16 if cfg.bf16 else jnp.float32)
+        x = nn.Dense(cfg.hidden_size, dtype=head_dtype, name="transform")(
+            sequence_output.astype(head_dtype))
         x = nn.gelu(x, approximate=False)
-        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="transform_ln")(x)
-        mlm_logits = nn.Dense(cfg.vocab_size, name="decoder")(x)
+        mlm_logits = nn.Dense(cfg.vocab_size, dtype=head_dtype,
+                              name="decoder")(x.astype(head_dtype))
         nsp_logits = nn.Dense(2, name="seq_relationship")(pooled)
         return mlm_logits, nsp_logits
 

@@ -47,6 +47,11 @@ class GPT2Config:
     # for full-block remat.
     remat_policy: Optional[str] = None
     attention_impl: str = "auto"    # auto | pallas | xla
+    # d=64 head packing in the flash kernel: "auto" pairs two heads per
+    # grid step on real TPU so every score/output matmul contracts over
+    # K=128 (the MXU's native width; unpacked d=64 runs half-starved),
+    # "packed"/"off" force it. Odd B*H counts pad one zero row.
+    attention_head_packing: str = "auto"
     # Sequence/context parallelism for long sequences: shard T over a
     # mesh axis and run ring (ppermute KV rotation) or ulysses
     # (all-to-all head swap) attention. Set sp_mesh to the engine mesh
@@ -137,7 +142,8 @@ def _attention(config, q, k, v, dropout_rng, deterministic):
                 f"valid values: {sorted(impls)} or None")
         fn = impls[config.sequence_parallel]
         return fn(q, k, v, mesh=config.sp_mesh,
-                  axis_name=config.sp_axis, causal=True)
+                  axis_name=config.sp_axis, causal=True,
+                  head_packing=config.attention_head_packing)
     if config.attention_impl in ("pallas", "auto"):
         try:
             from deepspeed_tpu.ops.transformer.flash_attention import (
@@ -149,8 +155,11 @@ def _attention(config, q, k, v, dropout_rng, deterministic):
                     # save_only_these_names:attn_out,attn_lse policy the
                     # backward never re-runs the flash fwd kernel
                     return flash_attention_rematerializable(
-                        q, k, v, causal=True)
-                return flash_attention(q, k, v, causal=True)
+                        q, k, v, causal=True,
+                        head_packing=config.attention_head_packing)
+                return flash_attention(
+                    q, k, v, causal=True,
+                    head_packing=config.attention_head_packing)
         except ImportError:
             pass
         if config.attention_impl == "pallas":

@@ -72,18 +72,19 @@ def _merge(acc, num, m_new, l_new):
 
 
 def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
-                      interpret=None):
+                      interpret=None, head_packing="auto"):
     """Per-device ring body on the Pallas flash kernel: each ring step
-    computes a NORMALIZED (out, lse) partial of local Q vs the held KV
-    block via `flash_attention_with_lse` (exp2-space softmax inside the
-    kernel, no materialized scores), then merges partials with
-    m = max(lse1, lse2); w_i = exp2(lse_i − m). Chunk-level causality
-    picks the kernel variant per step: the diagonal chunk runs the
-    causal kernel, strictly-lower chunks the non-causal one, upper
-    chunks contribute a zero partial (lse = −inf) without touching the
-    MXU."""
+    folds the held KV block into the running (out, lse) carry via
+    `flash_attention_merge` — the softmax-partial merge
+    (m = max(lse1, lse2); w_i = exp2(lse_i − m)) happens IN THE KERNEL
+    EPILOGUE, so the per-step partial never round-trips HBM through an
+    XLA elementwise merge chain (it previously cost ~5 extra passes
+    over [B,Tl,H,D] fp32 per ring step).  Chunk-level causality picks
+    the kernel variant per step: the diagonal chunk runs the causal
+    kernel, strictly-lower chunks the non-causal one, upper chunks
+    pass the carry through untouched (no kernel launch at all)."""
     from deepspeed_tpu.ops.transformer.flash_attention import \
-        flash_attention_with_lse
+        flash_attention_merge
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     s_size = jax.lax.psum(1, axis_name)
@@ -94,44 +95,32 @@ def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
     lse0 = jnp.full((b, h, tl, 1), NEG_INF, jnp.float32)
     perm = [(i, (i + 1) % s_size) for i in range(s_size)]
 
-    def partial_of(kb, vb, step_causal):
-        ob, lb = flash_attention_with_lse(
-            q, kb, vb, causal=step_causal, sm_scale=sm_scale,
-            interpret=interpret)
-        return ob.astype(jnp.float32), lb
+    def merged(kb, vb, o, lse, step_causal):
+        return flash_attention_merge(
+            q, kb, vb, o, lse, causal=step_causal, sm_scale=sm_scale,
+            interpret=interpret, head_packing=head_packing)
 
     def step(carry, step_idx):
         o, lse, kb, vb = carry
         src = (my_idx - step_idx) % s_size
 
         if causal:
-            def diag(_):
-                return partial_of(kb, vb, True)
+            def diag(args):
+                return merged(*args, True)
 
-            def full(_):
-                return partial_of(kb, vb, False)
+            def full(args):
+                return merged(*args, False)
 
-            def none(_):
-                return o0, lse0
+            def none(args):
+                return args[2], args[3]
 
             branch = jnp.where(src == my_idx, 0,
                                jnp.where(src < my_idx, 1, 2))
-            ob, lb = jax.lax.switch(branch, [diag, full, none], None)
+            o, lse = jax.lax.switch(branch, [diag, full, none],
+                                    (kb, vb, o, lse))
         else:
-            ob, lb = partial_of(kb, vb, False)
+            o, lse = merged(kb, vb, o, lse, False)
 
-        # merge normalized partials (disjoint key sets)
-        m = jnp.maximum(jnp.maximum(lse, lb), NEG_INF / 2)
-        w1 = jnp.exp2(lse - m)
-        w2 = jnp.exp2(lb - m)
-        denom = jnp.maximum(w1 + w2, 1e-30)
-
-        def bhq1_to_bqh1(x):
-            return x.transpose(0, 2, 1, 3)
-
-        o = (o * bhq1_to_bqh1(w1) + ob * bhq1_to_bqh1(w2)) / \
-            bhq1_to_bqh1(denom)
-        lse = m + jnp.log2(denom)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return (o, lse, kb, vb), None
@@ -200,17 +189,28 @@ def _mesh_targets_tpu(mesh):
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
-                   sm_scale=None, use_flash=None, interpret=None):
+                   sm_scale=None, use_flash=None, interpret=None,
+                   head_packing="auto"):
     """Ring attention over [B, T, H, D] with T sharded on `axis_name`.
 
     use_flash=None auto-selects the per-step Pallas flash body when the
     mesh's devices are TPUs (keyed on the MESH target, not
-    jax.default_backend(), so cross-backend AOT lowering selects
-    correctly — pass use_flash explicitly to override) and the LOCAL
-    chunk meets the kernel's tiling contract (chunk length a multiple of
-    128, head dim a multiple of 64); otherwise the XLA online-softmax
-    fallback runs. interpret forwards to the kernel so CPU tests
-    exercise the same code path. (Same selection applies to
+    jax.default_backend()) and the LOCAL chunk meets the kernel's
+    tiling contract (chunk length a multiple of 128, head dim a
+    multiple of 64); otherwise the XLA online-softmax fallback runs.
+    The flash body merges each step's (out, lse) partial in the kernel
+    epilogue (`flash_attention_merge`) and packs d=64 head pairs into
+    K=128 contractions per `head_packing` ("auto"|"packed"|"off").
+
+    **Cross-backend AOT lowering (CPU host → TPU target): pass
+    `use_flash=True` explicitly.** The auto-selection inspects the
+    mesh's devices AT TRACE TIME; device-bearing meshes resolve the
+    TPU target correctly even from a CPU host process, but abstract /
+    device-less meshes (e.g. `jax.sharding.AbstractMesh` under
+    `jax.export`-style lowering) fall back to the HOST backend and
+    would silently pick the XLA body for a TPU executable.  interpret
+    forwards to the kernel so CPU tests exercise the same code path.
+    (Same selection and the same AOT caveat apply to
     `ulysses_attention`.)"""
     from deepspeed_tpu.ops.transformer.flash_attention import \
         flash_attention_usable
@@ -229,7 +229,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
     if use_flash:
         body = functools.partial(_ring_local_flash, axis_name=axis_name,
                                  causal=causal, sm_scale=sm_scale,
-                                 interpret=interpret)
+                                 interpret=interpret,
+                                 head_packing=head_packing)
     else:
         body = functools.partial(ring_attention_local, axis_name=axis_name,
                                  causal=causal, sm_scale=sm_scale)
@@ -274,9 +275,14 @@ def ulysses_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
-                      sm_scale=None, use_flash=None):
+                      sm_scale=None, use_flash=None, head_packing="auto"):
     """Ulysses sequence-parallel attention over [B, T, H, D] with T
-    sharded on `axis_name`."""
+    sharded on `axis_name`.
+
+    Cross-backend AOT lowering (CPU host → TPU target) must pass
+    `use_flash=True` explicitly — see `ring_attention`'s note: the
+    auto-selection keys on the mesh's devices at trace time and a
+    device-less mesh falls back to the host backend."""
     from deepspeed_tpu.ops.transformer.flash_attention import (
         flash_attention, flash_attention_usable)
 
@@ -302,7 +308,8 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name="seq", causal=True,
         def attn_fn(qg, kg, vg):
             if flash_attention_usable(qg, True):
                 return flash_attention(qg, kg, vg, causal=causal,
-                                       sm_scale=sm_scale)
+                                       sm_scale=sm_scale,
+                                       head_packing=head_packing)
             return dense_attention(qg, kg, vg, causal=causal,
                                    sm_scale=sm_scale)
 

@@ -846,12 +846,30 @@ def layout_to_dense_mask(layout, seq_len, block):
 
 
 def block_sparse_attention(q, k, v, layout, block, causal=False,
-                           sm_scale=None, interpret=None):
+                           sm_scale=None, interpret=None,
+                           head_packing="auto"):
     """Block-sparse attention over [B, T, H, D].
 
     layout: [H, T/block, T/block] 0/1 matrix from a SparsityConfig.
+
+    head_packing: accepted for signature parity with the dense flash
+    kernel ("auto"|"packed"|"off") but the sparse kernels ALWAYS run
+    unpacked — the index-compacted tables are per-head (each head has
+    its own visible-block list), so pairing two heads into one K=128
+    contraction would force both onto the union of their layouts.
+    "auto"/"off" silently take the unpacked sparse kernel; "packed"
+    raises (use the dense kernel for packed d=64 attention).
     """
     b, t, h, d = q.shape
+    if head_packing in ("packed", True, 1):
+        raise ValueError(
+            "head_packing='packed' is not supported by the block-sparse "
+            "kernels (per-head visible-block tables don't pair); use "
+            "'auto'/'off', or the dense flash kernel for packed "
+            "attention")
+    if head_packing not in ("auto", "off", None, False, 0):
+        raise ValueError(
+            f"head_packing={head_packing!r}: expected 'auto' or 'off'")
     if isinstance(layout, jax.core.Tracer):
         raise ValueError(
             "block_sparse_attention requires a CONCRETE layout (it is "

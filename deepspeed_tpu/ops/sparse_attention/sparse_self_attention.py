@@ -34,7 +34,8 @@ class SparseSelfAttention:
     _layout_cache = {}
 
     def __init__(self, sparsity_config=None, key_padding_mask_mode="add",
-                 attn_mask_mode="mul", max_seq_length=2048):
+                 attn_mask_mode="mul", max_seq_length=2048,
+                 head_packing="auto"):
         self.sparsity_config = sparsity_config or FixedSparsityConfig(
             num_heads=4)
         assert key_padding_mask_mode in ("add", "mul")
@@ -42,6 +43,11 @@ class SparseSelfAttention:
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
         self.max_seq_length = max_seq_length
+        # forwarded to block_sparse_attention; the sparse kernels run
+        # unpacked regardless (per-head layouts don't pair) — this only
+        # validates/forwards the knob so model configs can plumb one
+        # value everywhere
+        self.head_packing = head_packing
 
     def get_layout(self, seq_len):
         key = (id(type(self.sparsity_config)),
@@ -66,7 +72,7 @@ class SparseSelfAttention:
         if not uses_masks:
             return block_sparse_attention(
                 query, key, value, layout, block, causal=causal,
-                interpret=not on_tpu)
+                interpret=not on_tpu, head_packing=self.head_packing)
 
         # masked path: fold masks into an additive bias and run the
         # dense-fallback math with the layout mask (exact, but O(T^2)

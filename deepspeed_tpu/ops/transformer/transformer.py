@@ -57,7 +57,8 @@ class DeepSpeedTransformerConfig:
                  huggingface=False,
                  training=True,
                  bf16=False,
-                 layer_norm_eps=1e-12):
+                 layer_norm_eps=1e-12,
+                 head_packing="auto"):
         self.batch_size = batch_size
         self.max_seq_length = max_seq_length
         self.hidden_size = hidden_size
@@ -84,6 +85,11 @@ class DeepSpeedTransformerConfig:
         # only; on TPU bf16 is the fast dtype).
         self.bf16 = bf16
         self.layer_norm_eps = layer_norm_eps
+        # d=64 head packing in the flash kernel ("auto"|"packed"|"off"):
+        # "auto" pairs two heads per grid step on real TPU so the
+        # score/output matmuls contract over K=128 instead of running
+        # the MXU half-starved at K=64 (flash_attention.py docstring).
+        self.head_packing = head_packing
 
     @classmethod
     def from_dict(cls, json_object):
@@ -175,7 +181,8 @@ class _TransformerLayerCore(nn.Module):
             from deepspeed_tpu.ops.transformer.flash_attention import (
                 flash_attention, flash_attention_usable)
             if flash_attention_usable(q, True):
-                return flash_attention(q, k, v, causal=False)
+                return flash_attention(q, k, v, causal=False,
+                                       head_packing=cfg.head_packing)
         # XLA path: additive mask ([B, 1, 1, T] or [B, 1, T, T]), fp32
         # softmax — the shape contract of the reference's fused softmax
         # kernel (`csrc/transformer/softmax_kernels.cu`).

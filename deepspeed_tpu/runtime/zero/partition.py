@@ -84,6 +84,7 @@ class ZeroShardingPolicy:
         self.dp_size = mesh.shape[DATA_AXIS]
         self.param_specs = param_specs
         self._warned_replicated_fallback = False
+        self._warned_compose_fallback = False
 
     # -- spec builders ----------------------------------------------------
     def _tp_spec_for(self, path_spec, leaf):
@@ -94,6 +95,7 @@ class ZeroShardingPolicy:
     def _specs(self, params, shard_over_data: bool):
         mp_size = self.mesh.shape.get(MODEL_AXIS, 1)
         fallback_elems = [0]   # numel that silently stays replicated
+        compose_failed = [0]   # …of which a (model, data) compose missed
 
         def one(leaf, tp_spec):
             if np.ndim(leaf) == 0:
@@ -108,11 +110,13 @@ class ZeroShardingPolicy:
                     # masters/moments divide by pipe*model*data.
                     base = list(spec)
                     shape = np.shape(leaf)
+                    had_model_dim = False
                     for d, s in enumerate(base):
-                        if s == MODEL_AXIS and \
-                                shape[d] % (mp_size * self.dp_size) == 0:
-                            base[d] = (MODEL_AXIS, DATA_AXIS)
-                            return PartitionSpec(*base)
+                        if s == MODEL_AXIS:
+                            had_model_dim = True
+                            if shape[d] % (mp_size * self.dp_size) == 0:
+                                base[d] = (MODEL_AXIS, DATA_AXIS)
+                                return PartitionSpec(*base)
                     # still nothing took DATA_AXIS: this leaf's
                     # masters/moments will be data-REPLICATED (the
                     # pad-plan may re-shard it later, but e.g. a
@@ -120,6 +124,8 @@ class ZeroShardingPolicy:
                     # loses the pipe*model*data memory division here)
                     if int(np.prod(shape)) >= 2 * self.dp_size:
                         fallback_elems[0] += int(np.prod(shape))
+                        if had_model_dim:
+                            compose_failed[0] += int(np.prod(shape))
                 return spec
             return self._tp_spec_for(tp_spec, leaf)
 
@@ -127,6 +133,22 @@ class ZeroShardingPolicy:
             out = jax.tree_util.tree_map(lambda l: one(l, None), params)
         else:
             out = jax.tree_util.tree_map(one, params, self.param_specs)
+        if compose_failed[0] and not self._warned_compose_fallback:
+            # ADVICE r5: the (MODEL_AXIS, DATA_AXIS) compose is how pipe
+            # flat buffers get the pipe*model*data memory division — a
+            # divisibility miss there is invisible in numerics and only
+            # shows up as per-device memory that stopped dividing by dp.
+            self._warned_compose_fallback = True
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                f"ZeRO: {compose_failed[0] / 1e6:.1f}M elements sit on a "
+                f"model-sharded dim that is NOT divisible by mp*dp="
+                f"{mp_size * self.dp_size}, so the (model, data) "
+                "composition fell back to data-REPLICATED masters/"
+                "moments — the model*data memory division is lost for "
+                "these leaves. Align flat layouts to a multiple of "
+                f"model*data (e.g. StageFlatLayout align={mp_size} * "
+                f"{self.dp_size}) to restore it")
         if fallback_elems[0] and not self._warned_replicated_fallback:
             self._warned_replicated_fallback = True
             from deepspeed_tpu.utils.logging import logger

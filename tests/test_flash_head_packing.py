@@ -1,0 +1,159 @@
+"""Head-packed vs unpacked flash-kernel parity (ISSUE 4 satellite).
+
+The packed kernel processes two d=64 heads per grid step in a
+feature-packed [rows, T, 128] layout with block-diagonal K/V so every
+score/output contraction runs at the MXU's native K=128
+(flash_attention.py module docstring). The zero lanes contribute exact
++0 to every fp32 partial sum, so packed and unpacked must agree to
+fp32 roundoff — forward AND backward — across head counts (even, and
+odd B·H exercising the one-row zero pad), seq lengths that are and are
+not multiples of the default block, causal/bidirectional, and
+bf16/fp32. Everything runs the real Pallas kernels in interpreter mode
+on CPU (head_packing="packed" forces the packed body; "auto" stays
+unpacked off-TPU by design)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer.flash_attention import (
+    _resolve_head_packing, flash_attention, flash_attention_merge,
+    flash_attention_with_lse)
+
+
+def qkv(b, t, h, d, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(b, t, h, d), dtype) for _ in range(3)]
+
+
+def ab(x, dtype=np.float32):
+    return np.asarray(x, dtype)
+
+
+# fp32 accumulates identically in both kernels (the packed zero lanes
+# add exact +0); bf16 pays one output-rounding step per kernel, so the
+# two paths can land one ULP apart after the fp32->bf16 cast.
+TOL = {jnp.float32: dict(atol=2e-6, rtol=2e-6),
+       jnp.bfloat16: dict(atol=2e-2, rtol=2e-2)}
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["fp32", "bf16"])
+@pytest.mark.parametrize("causal", [True, False],
+                         ids=["causal", "bidir"])
+@pytest.mark.parametrize("b,t,h", [
+    (2, 128, 2),    # even B*H, single 128 tile
+    (1, 256, 3),    # ODD B*H -> one-row zero pad, multi-tile
+    (1, 384, 2),    # T=384: NOT a multiple of the 1024 default block
+                    # (_fit_block shrinks to 128-wide tiles)
+])
+def test_forward_parity(b, t, h, causal, dtype):
+    q, k, v = qkv(b, t, h, 64, dtype)
+    packed = flash_attention(q, k, v, causal=causal, interpret=True,
+                             head_packing="packed")
+    unpacked = flash_attention(q, k, v, causal=causal, interpret=True,
+                               head_packing="off")
+    assert packed.dtype == unpacked.dtype == dtype
+    np.testing.assert_allclose(ab(packed), ab(unpacked), **TOL[dtype])
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16],
+                         ids=["fp32", "bf16"])
+@pytest.mark.parametrize("causal", [True, False],
+                         ids=["causal", "bidir"])
+@pytest.mark.parametrize("b,t,h", [
+    (2, 128, 2),    # single-tile -> fused one-pass backward kernel
+    (1, 256, 3),    # odd B*H + multi-tile -> dkv+dq sweep kernels
+])
+def test_backward_parity(b, t, h, causal, dtype):
+    q, k, v = qkv(b, t, h, 64, dtype, seed=3)
+
+    def loss(hp):
+        def f(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, interpret=True,
+                                  head_packing=hp)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    for g_p, g_u in zip(loss("packed"), loss("off")):
+        assert g_p.dtype == g_u.dtype == dtype
+        np.testing.assert_allclose(ab(g_p), ab(g_u), **TOL[dtype])
+
+
+def test_lse_parity():
+    """The saved logsumexp rows (log2 space) drive both backward
+    kernels and the ring merge — they must match too, including on the
+    odd pad row's real neighbors."""
+    q, k, v = qkv(1, 256, 3, 64, seed=5)
+    out_p, lse_p = flash_attention_with_lse(
+        q, k, v, causal=True, interpret=True, head_packing="packed")
+    out_u, lse_u = flash_attention_with_lse(
+        q, k, v, causal=True, interpret=True, head_packing="off")
+    np.testing.assert_allclose(ab(out_p), ab(out_u), atol=2e-6, rtol=2e-6)
+    np.testing.assert_allclose(ab(lse_p), ab(lse_u), atol=2e-6, rtol=2e-6)
+
+
+@pytest.mark.parametrize("causal", [True, False], ids=["causal", "bidir"])
+def test_merge_parity(causal):
+    """Ring-step epilogue merge: packed vs unpacked kernels folding the
+    same prior (out, lse) partial must agree in the merged result AND
+    in the gradients flowing to the prior partial (the ring backward
+    differentiates through every step's carry)."""
+    b, t, h = 1, 256, 2
+    q, k, v = qkv(b, t, h, 64, seed=7)
+    k2, v2 = qkv(b, t, h, 64, seed=11)[:2]
+    prev_out, prev_lse = flash_attention_with_lse(
+        q, k2, v2, causal=False, interpret=True, head_packing="off")
+
+    def merged(hp):
+        def f(q, k, v, po, pl):
+            o, l = flash_attention_merge(q, k, v, po, pl, causal=causal,
+                                         interpret=True, head_packing=hp)
+            return jnp.sum(o ** 2) + jnp.sum(l ** 2)
+        out = flash_attention_merge(q, k, v, prev_out, prev_lse,
+                                    causal=causal, interpret=True,
+                                    head_packing=hp)
+        grads = jax.grad(f, argnums=(0, 1, 2, 3, 4))(
+            q, k, v, prev_out, prev_lse)
+        return out, grads
+
+    (o_p, l_p), g_p = merged("packed")
+    (o_u, l_u), g_u = merged("off")
+    np.testing.assert_allclose(ab(o_p), ab(o_u), atol=2e-6, rtol=2e-6)
+    np.testing.assert_allclose(ab(l_p), ab(l_u), atol=2e-6, rtol=2e-6)
+    for a, b_ in zip(g_p, g_u):
+        np.testing.assert_allclose(ab(a), ab(b_), atol=1e-4, rtol=1e-4)
+
+
+def test_packed_matches_dense_reference():
+    """Not just self-consistency: the packed kernel against the plain
+    XLA softmax(QK^T)V reference."""
+    q, k, v = qkv(1, 256, 4, 64, seed=13)
+    scale = 1.0 / np.sqrt(64)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((256, 256), bool))
+    s = jnp.where(mask[None, None], s, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          head_packing="packed")
+    np.testing.assert_allclose(ab(out), ab(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_resolution_rules():
+    # d != 64 cannot pack: forcing is an error, auto falls back
+    with pytest.raises(ValueError, match="head_dim 64"):
+        _resolve_head_packing("packed", 128, False)
+    assert not _resolve_head_packing("auto", 128, False)
+    # interpreter path (CPU CI) stays unpacked under auto, packs on TPU
+    assert not _resolve_head_packing("auto", 64, True)
+    assert _resolve_head_packing("auto", 64, False)
+    assert _resolve_head_packing("packed", 64, True)
+    assert not _resolve_head_packing("off", 64, False)
+    with pytest.raises(ValueError, match="head_packing"):
+        _resolve_head_packing("sideways", 64, False)
+    # d=128 (no packing possible) still runs fine under auto
+    q, k, v = qkv(1, 128, 2, 128, seed=17)
+    out = flash_attention(q, k, v, causal=True, interpret=True,
+                          head_packing="auto")
+    assert out.shape == (1, 128, 2, 128)

@@ -41,6 +41,90 @@ def test_get_model_profile_flax():
     assert flops >= 2 * 4 * 32 * 64 + 2 * 4 * 64 * 16
 
 
+def test_calls_re_splits_condition_and_body():
+    # a while line lists its callees unbraced and comma-separated; the
+    # unbraced alternative must stop at the name (a greedy capture would
+    # swallow ", body" into the condition's name and drop the body)
+    from deepspeed_tpu.profiling.flops_profiler.profiler import (_CALLS_RE,
+                                                                 _TRIP_RE)
+    line = ('%while.1 = (f32[8,8]{1,0}, s32[]) while(%tuple.1), '
+            'condition=%cond_comp.2, body=%body_comp.3, '
+            'backend_config={"known_trip_count":{"n":"7"}}')
+    names = []
+    for m in _CALLS_RE.finditer(line):
+        got = m.group(1) if m.group(1) is not None else m.group(2)
+        names += [t.strip().lstrip("%") for t in got.split(",") if t.strip()]
+    assert names == ["cond_comp.2", "body_comp.3"]
+    t = _TRIP_RE.search(line)
+    assert t and int(t.group(1)) == 7
+    # braced form (branch_computations) still splits on commas
+    braced = ('%cond.9 = f32[] conditional(%p.0), '
+              'branch_computations={%br_a.1, %br_b.2}')
+    bnames = []
+    for m in _CALLS_RE.finditer(braced):
+        got = m.group(1) if m.group(1) is not None else m.group(2)
+        bnames += [t.strip().lstrip("%") for t in got.split(",") if t.strip()]
+    assert bnames == ["br_a.1", "br_b.2"]
+
+
+def test_per_fusion_costs_scan_trip_count_multiplier():
+    # a scanned matmul lowers to a while loop whose body XLA annotates
+    # with known_trip_count; the body's dot/fusion rows must be scaled
+    # by the trip count, not counted once
+    from deepspeed_tpu.profiling.flops_profiler.profiler import \
+        per_fusion_costs
+    steps, n = 6, 64
+
+    def fn(x, w):
+        def body(carry, _):
+            return jnp.tanh(carry @ w), None
+        out, _ = jax.lax.scan(body, x, None, length=steps)
+        return out
+
+    x = jnp.ones((n, n), jnp.float32)
+    w = jnp.ones((n, n), jnp.float32)
+    rows = per_fusion_costs(fn, x, w, peak_flops=1e12, hbm_gbps=100.0)
+    assert rows, "expected at least one fusion/dot row"
+    per_step = 2 * n ** 3
+    flop_rows = [r for r in rows if r["flops"] > 0]
+    assert flop_rows, "expected a row with visible dot flops"
+    total_flops = sum(r["flops"] for r in flop_rows)
+    # all `steps` iterations must be accounted for (the unfixed parser
+    # dropped the while body entirely, leaving at most one step's flops)
+    assert total_flops >= steps * per_step * 0.9, \
+        f"scan body under-counted: {total_flops} < {steps}*{per_step}"
+    assert any(r["calls"] >= steps for r in flop_rows)
+
+
+def test_per_fusion_costs_dus_carry_not_inflated():
+    # stacking ys in a scan lowers to a loop fusion whose ROOT
+    # dynamic-update-slices the stacked buffer (aliased in place, one
+    # slice touched per trip); charging the full buffer x trip_count
+    # would let this near-free carry update out-rank the real matmuls
+    from deepspeed_tpu.profiling.flops_profiler.profiler import \
+        per_fusion_costs
+    steps, n = 8, 64
+
+    def fn(x, w):
+        def body(c, _):
+            c = jnp.tanh(c @ w)
+            return c, c
+        return jax.lax.scan(body, x, None, length=steps)
+
+    x = jnp.ones((n, n), jnp.float32)
+    w = jnp.ones((n, n), jnp.float32)
+    rows = per_fusion_costs(fn, x, w, peak_flops=1e12, hbm_gbps=100.0)
+    stack_bytes = steps * n * n * 4
+    for r in rows:
+        if r["flops"]:
+            continue
+        # flopless loop fusions (the ys-stacking DUS) must stay at
+        # slice-traffic scale: well under a few x the stacked buffer,
+        # nowhere near trip_count x full-buffer (= steps * stack_bytes)
+        assert r["bytes"] <= 4 * stack_bytes, \
+            f"DUS fusion bytes inflated: {r}"
+
+
 def test_engine_profile_step_runs(capsys):
     from deepspeed_tpu.models.gpt2 import tiny_gpt2_config, GPT2ForCausalLM
     cfg = tiny_gpt2_config(n_layer=2, dropout=0.0)

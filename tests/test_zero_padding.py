@@ -156,3 +156,41 @@ def test_pad_plan_respects_tp_claimed_dims(mesh8):
     plan = policy.pad_plan(params)
     (dim, padded, true), = plan.values()
     assert dim == 1 and (padded, true) == (24, 20)
+
+
+def test_compose_fallback_warns(monkeypatch):
+    """ADVICE r5: a leaf whose model-sharded dim divides mp but NOT
+    mp*dp silently loses the (model, data) composed sharding — the
+    policy must say so (the regression is invisible in numerics; it
+    only shows as per-device memory no longer dividing by dp)."""
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu.runtime.mesh import build_mesh
+    from deepspeed_tpu.utils.logging import logger
+
+    mesh = build_mesh({"pipe": 1, "data": 4, "model": 2})
+    warnings = []
+    monkeypatch.setattr(logger, "warning",
+                        lambda msg, *a: warnings.append(msg % a if a else msg))
+
+    # dim1=6: % mp(2) == 0 so it is model-sharded, but % mp*dp(8) != 0
+    # -> compose fails; dim0=3 offers no free dp dim; numel 18 >= 2*dp
+    params = {"w": jnp.zeros((3, 6))}
+    policy = ZeroShardingPolicy(mesh, stage=2,
+                                param_specs={"w": P(None, "model")})
+    specs = policy.master_pspecs(params)
+    assert specs["w"] == P(None, "model")       # data-replicated fallback
+    assert any("mp*dp" in w for w in warnings), warnings
+    assert policy._warned_compose_fallback
+    # warning is once-per-policy, not per-call
+    n = len(warnings)
+    policy.master_pspecs(params)
+    assert len(warnings) == n
+
+    # divisible by mp*dp -> composes, no compose warning
+    warnings.clear()
+    params = {"w": jnp.zeros((3, 16))}
+    policy2 = ZeroShardingPolicy(mesh, stage=2,
+                                 param_specs={"w": P(None, "model")})
+    specs = policy2.master_pspecs(params)
+    assert specs["w"] == P(None, ("model", "data"))
+    assert not any("mp*dp" in w for w in warnings), warnings
