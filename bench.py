@@ -1378,6 +1378,113 @@ def bench_async_checkpoint():
     return out
 
 
+def bench_monitor_overhead():
+    """Telemetry overhead A/B (ISSUE 5): the SAME async-dispatch train
+    loop with monitor off vs monitor on (JSONL sink + device-side
+    metric accumulators + fence drains every steps_per_sync). The
+    monitor's contract is <3% step-time overhead: per-step cost is one
+    extra jitted fold dispatch (a 6-float vector add, async like the
+    step itself), per-fence cost is one device_get of that vector plus
+    gauge sampling and a sink write. Windows INTERLEAVE (best-of-N per
+    leg) so load drift on a shared machine hits both legs equally.
+    Also returns `engine.monitor.snapshot()` — bench extras and
+    training telemetry share one schema by construction."""
+    import shutil
+    import tempfile
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+    from deepspeed_tpu import initialize
+
+    batch, seq = 8, 64
+    steps, warmup, windows = 20, 5, 10
+    cfg = tiny_gpt2_config(n_positions=seq, dropout=0.0)
+    tmp = tempfile.mkdtemp(prefix="ds_monitor_bench_")
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    def build(monitor_on):
+        model = GPT2ForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((batch, seq),
+                                                   np.int32)})
+        engine, _, _, _ = initialize(
+            model=model, model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 100000,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                # fences every 5 steps so the drain cost is IN the
+                # measured window, not dodged by a huge sync period
+                "async_dispatch": {"enabled": True, "steps_per_sync": 5},
+                "monitor": {"enabled": monitor_on,
+                            "sinks": ["jsonl"],
+                            "output_path": tmp,
+                            "job_name": "on" if monitor_on else "off"},
+            })
+        del params
+        assert engine.monitor.enabled == monitor_on
+        for i in range(warmup):
+            loss = engine.train_batch(batch=make_batch(i))
+        _sync(loss)
+        return engine
+
+    def window(engine, base):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = engine.train_batch(batch=make_batch(base + i))
+        _sync(loss)
+        return time.perf_counter() - t0
+
+    out = {}
+    try:
+        engines = {"off": build(False), "on": build(True)}
+        # PAIRED windows (back to back, order ALTERNATING per pair) and
+        # a median of the per-pair ratios: load drift on a shared box
+        # moves both legs of a pair together and the alternation
+        # cancels any first-vs-second systematic, so the ratio stays
+        # clean where best-of-N absolute times do not
+        times = {"off": [], "on": []}
+        ratios = []
+        for w in range(windows):
+            order = ("off", "on") if w % 2 == 0 else ("on", "off")
+            t = {}
+            for name in order:
+                t[name] = window(engines[name], 1000 + w * steps)
+            times["off"].append(t["off"])
+            times["on"].append(t["on"])
+            ratios.append(t["on"] / t["off"])
+
+        best = {k: min(v) for k, v in times.items()}
+        out = {
+            "model": "gpt2-tiny-smoke (bf16, async dispatch, "
+                     "fences every 5 steps)",
+            "off": {"steps_per_sec": round(steps / best["off"], 2),
+                    "step_ms": round(best["off"] * 1e3 / steps, 3)},
+            "on": {"steps_per_sec": round(steps / best["on"], 2),
+                   "step_ms": round(best["on"] * 1e3 / steps, 3)},
+        }
+        overhead = (float(np.median(ratios)) - 1.0) * 100.0
+        out["overhead_pct"] = round(overhead, 2)
+        out["regressed"] = bool(overhead >= 3.0)
+        snap = engines["on"].monitor.snapshot()
+        # the proof the sink actually recorded the run: parse it back
+        path = os.path.join(tmp, "on", "events.jsonl")
+        n_events = sum(1 for line in open(path)
+                       if json.loads(line).get("kind") == "metrics")
+        out["jsonl_metric_events"] = n_events
+        out["snapshot"] = {k: snap[k] for k in
+                           ("loss", "lr", "samples_per_sec", "tokens",
+                            "overflow_count")}
+        engines["on"].monitor.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def timeit_once(fn):
     t0 = time.perf_counter()
     fn()
@@ -1390,6 +1497,7 @@ def timeit_once(fn):
 BENCH_LEGS = {
     "async_checkpoint": bench_async_checkpoint,
     "async_dispatch": bench_async_dispatch,
+    "monitor_overhead": bench_monitor_overhead,
     "gpt2_350m": bench_gpt2_350m,
     "bert_large_fused_seq128": bench_bert_large,
     "flash_head_packing": bench_flash_head_packing,

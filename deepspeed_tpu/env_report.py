@@ -66,9 +66,22 @@ def debug_report():
         pass
     try:
         devices = jax.devices()
+        report.append(("platform", devices[0].platform))
         report.append(("backend", jax.default_backend()))
         report.append(("device count", len(devices)))
         report.append(("device kind", devices[0].device_kind))
+        from deepspeed_tpu.utils.timer import device_memory_stats
+        mem = device_memory_stats()
+        if mem["device_count"]:
+            gib = 1024 ** 3
+            report.append((
+                "device memory",
+                f"{mem['in_use_bytes'] / gib:.2f} GiB in use, "
+                f"{mem['peak_bytes'] / gib:.2f} GiB peak "
+                f"({mem['device_count']} local devices)"))
+        else:
+            report.append(("device memory",
+                           "allocator stats unavailable on this backend"))
     except Exception as e:
         report.append(("devices", f"unavailable: {e}"))
     import deepspeed_tpu
@@ -81,8 +94,46 @@ def debug_report():
         print(f"{name} {'.' * (28 - len(name))} {value}")
 
 
+def feature_report():
+    """Runtime feature availability: monitor sinks, native CPU-Adam,
+    Pallas flash attention."""
+    rows = []
+    try:
+        from deepspeed_tpu.monitor.sinks import VALID_SINKS
+        rows.append(("monitor sinks",
+                     f"{SUCCESS} {', '.join(VALID_SINKS)} "
+                     "(dependency-free: no torch/tensorflow)"))
+    except Exception as e:
+        rows.append(("monitor sinks", f"{FAIL} {e}"))
+    try:
+        from op_builder import CPUAdamBuilder
+        native = CPUAdamBuilder().is_compatible()
+        rows.append(("native CPU-Adam",
+                     SUCCESS if native else
+                     f"{WARNING} numpy fallback (no C++ toolchain)"))
+    except Exception as e:
+        rows.append(("native CPU-Adam", f"{WARNING} {e}"))
+    try:
+        import jax
+        from jax.experimental import pallas  # noqa: F401
+        on_tpu = jax.devices()[0].platform == "tpu"
+        rows.append(("Pallas flash attention",
+                     SUCCESS if on_tpu else
+                     f"{SUCCESS} interpret mode (no TPU attached)"))
+    except Exception as e:
+        rows.append(("Pallas flash attention", f"{FAIL} {e}"))
+
+    print("-" * 64)
+    print("runtime feature report")
+    print("-" * 64)
+    for name, value in rows:
+        print(f"{name} {'.' * (28 - len(name))} {value}")
+    print("-" * 64)
+
+
 def main():
     op_report()
+    feature_report()
     debug_report()
 
 

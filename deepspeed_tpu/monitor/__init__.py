@@ -1,0 +1,331 @@
+"""deepspeed_tpu.monitor — unified async-safe telemetry.
+
+One subsystem, three parts (docs/monitoring.md):
+
+  * MetricsRegistry (registry.py): hot-path metrics live as ONE
+    device-side accumulator vector folded per step with an async jitted
+    add and drained with exactly one `device_get` at the engine's
+    `steps_per_sync` fences — zero new per-step host syncs; host
+    gauges (checkpoint queue depth / commit latency, prefetch
+    occupancy, device memory) sample at the same fences.
+  * Pluggable sinks (sinks.py): schema-versioned JSONL event log and a
+    dependency-free native tfevents writer (tfevents.py) — plus the
+    in-process `engine.monitor.snapshot()` API bench.py reuses, so
+    bench extras and training telemetry share one schema.
+  * Step tracing + stall watchdog (trace.py / watchdog.py): named
+    spans via `jax.profiler.TraceAnnotation` recorded fence-aligned
+    (`wall_clock_breakdown=true` rides this path instead of the
+    barrier-per-microstep timers), and a background thread that fires
+    when no fence advances within `stall_timeout_sec`.
+
+The Monitor object orchestrates the three against one engine; every
+hook is a no-op behind a single attribute check when
+`monitor.enabled` is false (the default).
+"""
+
+import os
+import time
+import weakref
+
+from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
+                                          MonitorConfigError)
+from deepspeed_tpu.monitor.registry import MetricsRegistry
+from deepspeed_tpu.monitor.sinks import (SCHEMA_VERSION, base_event,
+                                         build_sinks)
+from deepspeed_tpu.monitor.trace import (SPAN_BACKWARD, SPAN_CKPT,
+                                         SPAN_FORWARD, SPAN_PREFETCH,
+                                         SPAN_STEP, StepTrace)
+from deepspeed_tpu.monitor.watchdog import StallWatchdog
+
+__all__ = [
+    "Monitor", "MetricsRegistry", "StepTrace", "StallWatchdog",
+    "DeepSpeedMonitorConfig", "MonitorConfigError", "SCHEMA_VERSION",
+    "SPAN_FORWARD", "SPAN_BACKWARD", "SPAN_STEP", "SPAN_CKPT",
+    "SPAN_PREFETCH",
+]
+
+_MONITOR_OUTPUT_DEFAULT = "ds_monitor"
+
+
+class Monitor:
+    """Per-engine telemetry orchestrator.
+
+    Lifecycle: the engine constructs one Monitor in __init__ and calls
+    `on_step` after each fused step (device-side fold, no sync) and
+    `on_fence` inside `_sync_fence` (the one drain + sink emit point).
+    Subsystems running off the main thread (checkpoint writer, stall
+    watchdog, prefetch worker) use `event`/`heartbeat`, which are
+    thread-safe.
+    """
+
+    def __init__(self, engine, config: DeepSpeedMonitorConfig):
+        self.config = config
+        self.enabled = bool(config.enabled)
+        # weakref: the watchdog thread must not pin dead engines (and
+        # their device state) alive through the monitor
+        self._engine_ref = weakref.ref(engine)
+        self.registry = MetricsRegistry()
+        self.trace = StepTrace()
+        self.sinks = []
+        self.watchdog = None
+        self._armed = False
+        self._last_fence_t = None
+        self._last_flush_t = 0.0
+        self._prefetch_ref = None
+        self._cum = {"steps": 0, "overflow_count": 0, "tokens": 0}
+        self._last = {}          # most recent drained window metrics
+        # gauges register even when disabled so snapshot() keeps its
+        # stable key set on a monitor-off engine
+        self._register_default_gauges()
+        if not self.enabled:
+            return
+
+        import jax
+        rank0 = jax.process_index() == 0
+        if rank0 or config.all_ranks:
+            out_dir = config.output_path or _MONITOR_OUTPUT_DEFAULT
+            job = config.job_name
+            if config.all_ranks and not rank0:
+                job = os.path.join(job or "",
+                                   f"rank{jax.process_index()}")
+            self.sinks = build_sinks(config.sinks, out_dir, job)
+        if config.stall_timeout_sec > 0:
+            self.watchdog = StallWatchdog(
+                config.stall_timeout_sec,
+                probe=config.stall_probe,
+                emit=self._emit_kind)
+
+    # ------------------------------------------------------------------
+    # gauges
+    # ------------------------------------------------------------------
+    def _register_default_gauges(self):
+        ref = self._engine_ref
+
+        def ckpt_queue_depth():
+            e = ref()
+            w = getattr(e, "_ckpt_writer", None) if e else None
+            return 0.0 if w is None else float(w.queue_depth())
+
+        def prefetch_occupancy():
+            loader = self._prefetch_ref() if self._prefetch_ref else None
+            if loader is None:
+                return None
+            return {"occupancy": loader.occupancy(),
+                    "depth": loader.depth}
+
+        from deepspeed_tpu.utils.timer import device_memory_stats
+        self.registry.add_gauge("checkpoint/queue_depth",
+                                ckpt_queue_depth)
+        self.registry.add_gauge("prefetch", prefetch_occupancy)
+        self.registry.add_gauge("memory", device_memory_stats)
+
+    def attach_prefetch(self, loader):
+        """Remember the live PrefetchLoader for the occupancy gauge."""
+        self._prefetch_ref = weakref.ref(loader)
+
+    def heartbeat(self, source):
+        if self.watchdog is not None:
+            self.watchdog.heartbeat(source)
+
+    # ------------------------------------------------------------------
+    # hot path
+    # ------------------------------------------------------------------
+    def on_step(self, loss=None, grad_norm=None, loss_scale=None,
+                overflow=None, tokens=0, wire_stats=None):
+        """Fold one step's metrics. Device scalars stay on device (one
+        async jitted add); host numbers go to counters. NO host<->
+        device sync on this path — the fence-alignment guard test pins
+        it."""
+        if not self.enabled:
+            return
+        self.registry.fold_step(loss, grad_norm, loss_scale, overflow,
+                                tokens)
+        if wire_stats:
+            self.registry.inc("wire/d2h_bytes",
+                              wire_stats.get("d2h_bytes", 0))
+            self.registry.inc("wire/h2d_bytes",
+                              wire_stats.get("h2d_bytes", 0))
+        if not self._armed and self.watchdog is not None:
+            self._armed = True
+            self.watchdog.arm()
+
+    # ------------------------------------------------------------------
+    # fence drain
+    # ------------------------------------------------------------------
+    def _wire_dict(self, counters):
+        e = self._engine_ref()
+        stats = getattr(e, "wire_stats", None) if e else None
+        stats = stats or {}
+        return {
+            "d2h_bytes": int(counters.get("wire/d2h_bytes", 0)),
+            "h2d_bytes": int(counters.get("wire/h2d_bytes", 0)),
+            "grad_bits": stats.get("grad_bits"),
+            "param_bits": stats.get("param_bits"),
+        }
+
+    def _checkpoint_dict(self, counters, gauges):
+        return {
+            "queue_depth": int(gauges.get("checkpoint/queue_depth", 0)),
+            "commits": int(counters.get("ckpt/commits", 0)),
+            "last_commit_ms": counters.get("ckpt/last_commit_ms"),
+        }
+
+    def on_fence(self):
+        """The ONE telemetry rendezvous: drain the device accumulator
+        (a single device_get), sample host gauges, emit a metrics
+        event, and tell the watchdog the run is alive. Returns the
+        event (or None) so the engine can reuse it for breakdown
+        logging."""
+        if not self.enabled:
+            return None
+        if self.watchdog is not None:
+            self.watchdog.notify_fence()
+        e = self._engine_ref()
+        if e is None:
+            return None
+        window = self.registry.drain_device()
+        now = time.perf_counter()
+        if window is None:
+            self._maybe_flush()
+            return None
+        self._last = window
+        self._cum["steps"] += window["steps"]
+        self._cum["overflow_count"] += window["overflow_count"]
+        self._cum["tokens"] += window["tokens"]
+
+        counters = self.registry.counters()
+        gauges = self.registry.sample_gauges()
+        event = base_event("metrics", e._host_steps)
+        event.update(
+            micro_steps=e.micro_steps,
+            # None when no step in the window reported one (e.g.
+            # release_loss=True loops) — never a fabricated 0.0
+            loss=None if window["loss"] is None
+            else round(window["loss"], 6),
+            grad_norm=None if window["grad_norm"] is None
+            else round(window["grad_norm"], 6),
+            loss_scale=window["loss_scale"],
+            lr=e._current_lr(),
+            window_steps=window["steps"],
+            overflow_count=self._cum["overflow_count"],
+            tokens=self._cum["tokens"],
+            samples_per_sec=round(e.tput_timer.avg_samples_per_sec(), 3),
+        )
+        if self._last_fence_t is not None and now > self._last_fence_t:
+            event["tokens_per_sec"] = round(
+                window["tokens"] / (now - self._last_fence_t), 1)
+        self._last_fence_t = now
+        event["memory"] = {
+            k.split("/", 1)[1]: v for k, v in gauges.items()
+            if k.startswith("memory/")}
+        event["wire"] = self._wire_dict(counters)
+        event["checkpoint"] = self._checkpoint_dict(counters, gauges)
+        event["prefetch"] = {
+            "occupancy": gauges.get("prefetch/occupancy"),
+            "depth": gauges.get("prefetch/depth"),
+        }
+        spans = self.trace.drain()
+        if spans:
+            event["spans"] = spans
+        self._emit(event)
+        self._maybe_flush()
+        return event
+
+    # ------------------------------------------------------------------
+    # events / sinks
+    # ------------------------------------------------------------------
+    def _emit(self, event):
+        for sink in self.sinks:
+            try:
+                sink.emit(event)
+            except Exception:
+                pass
+
+    def _emit_kind(self, kind, fields):
+        """Thread-safe host-event hook (checkpoint writer, watchdog)."""
+        if not self.enabled:
+            return
+        e = self._engine_ref()
+        event = base_event(kind, e._host_steps if e else 0)
+        event.update(fields)
+        self._emit(event)
+
+    def event(self, kind, **fields):
+        self._emit_kind(kind, fields)
+
+    def _maybe_flush(self):
+        now = time.monotonic()
+        if now - self._last_flush_t >= self.config.flush_interval:
+            self._last_flush_t = now
+            for sink in self.sinks:
+                try:
+                    sink.flush()
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
+    # snapshot API (bench.py shares this schema)
+    # ------------------------------------------------------------------
+    SNAPSHOT_KEYS = (
+        "schema", "enabled", "step", "micro_steps", "loss", "grad_norm",
+        "loss_scale", "lr", "overflow_count", "tokens",
+        "samples_per_sec", "memory", "wire", "checkpoint", "prefetch",
+    )
+
+    def snapshot(self):
+        """In-process telemetry snapshot with a STABLE key set across
+        engine modes (bf16 / fp16 / ZeRO-2 / offload) — unknown values
+        are None, never missing keys. This is a user-initiated sync
+        point (it drains the device accumulator)."""
+        e = self._engine_ref()
+        window = self.registry.drain_device()
+        if window is not None:
+            self._last = window
+            self._cum["steps"] += window["steps"]
+            self._cum["overflow_count"] += window["overflow_count"]
+            self._cum["tokens"] += window["tokens"]
+            # snapshot consumed the token window: the next fence's
+            # tokens_per_sec must measure from here, not from the
+            # pre-snapshot fence
+            self._last_fence_t = time.perf_counter()
+        last = self._last
+        counters = self.registry.counters()
+        gauges = self.registry.sample_gauges()
+        snap = {
+            "schema": SCHEMA_VERSION,
+            "enabled": self.enabled,
+            "step": e._host_steps if e else None,
+            "micro_steps": e.micro_steps if e else None,
+            "loss": last.get("loss"),
+            "grad_norm": last.get("grad_norm"),
+            "loss_scale": last.get("loss_scale"),
+            "lr": e._current_lr() if e else None,
+            "overflow_count": self._cum["overflow_count"],
+            "tokens": self._cum["tokens"],
+            "samples_per_sec":
+                round(e.tput_timer.avg_samples_per_sec(), 3) if e
+                else None,
+            "memory": {
+                k.split("/", 1)[1]: v for k, v in gauges.items()
+                if k.startswith("memory/")},
+            "wire": self._wire_dict(counters),
+            "checkpoint": self._checkpoint_dict(counters, gauges),
+            "prefetch": {
+                "occupancy": gauges.get("prefetch/occupancy"),
+                "depth": gauges.get("prefetch/depth"),
+            },
+        }
+        return snap
+
+    # ------------------------------------------------------------------
+    def close(self):
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
+        for sink in self.sinks:
+            try:
+                sink.flush()
+                sink.close()
+            except Exception:
+                pass
+        self.sinks = []

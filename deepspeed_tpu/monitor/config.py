@@ -1,0 +1,79 @@
+"""`monitor` config block parsing.
+
+    {"monitor": {"enabled": true,
+                 "sinks": ["jsonl", {"type": "tensorboard"}],
+                 "output_path": "runs/exp1/monitor",
+                 "job_name": "",
+                 "flush_interval": 0,
+                 "stall_timeout_sec": 0,
+                 "stall_probe": false,
+                 "all_ranks": false}}
+
+enabled: master switch; off (the default) makes every monitor hook a
+  single attribute check.
+sinks: list of sink names or {"type": name, ...opts} dicts
+  (monitor/sinks.py). Default ["jsonl"].
+output_path: directory sinks write under (default "./ds_monitor").
+flush_interval: seconds between sink flushes (0 = flush every fence).
+  A flush makes buffered records VISIBLE to readers; it never fsyncs —
+  crash durability is paid once, at close() (a per-fence fsync costs
+  more than the fenced training window on some filesystems).
+stall_timeout_sec: fire the stall watchdog when no sync fence advances
+  for this long (0 = watchdog off).
+stall_probe: on a stall, also time an `effects_barrier` on a
+  sacrificial thread to tell a wedged device from a stalled host.
+all_ranks: emit events from every process (default: rank 0 only, with
+  a per-rank filename suffix when enabled).
+"""
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+
+
+class MonitorConfigError(Exception):
+    pass
+
+
+class DeepSpeedMonitorConfig:
+    def __init__(self, param_dict):
+        block = param_dict.get(C.MONITOR, {})
+        if not isinstance(block, dict):
+            raise MonitorConfigError(
+                f'"monitor" must be a dict, got {block!r}')
+        self.enabled = bool(get_scalar_param(
+            block, C.MONITOR_ENABLED, C.MONITOR_ENABLED_DEFAULT))
+        self.sinks = block.get(C.MONITOR_SINKS,
+                               list(C.MONITOR_SINKS_DEFAULT))
+        if not isinstance(self.sinks, (list, tuple)):
+            raise MonitorConfigError(
+                f"monitor.sinks must be a list, got {self.sinks!r}")
+        from deepspeed_tpu.monitor.sinks import VALID_SINKS
+        for spec in self.sinks:
+            name = spec if isinstance(spec, str) else \
+                (spec or {}).get("type")
+            if name not in VALID_SINKS:
+                raise MonitorConfigError(
+                    f"unknown monitor sink {name!r}; valid: "
+                    f"{list(VALID_SINKS)}")
+        self.output_path = get_scalar_param(
+            block, C.MONITOR_OUTPUT_PATH, C.MONITOR_OUTPUT_PATH_DEFAULT)
+        self.job_name = get_scalar_param(
+            block, C.MONITOR_JOB_NAME, C.MONITOR_JOB_NAME_DEFAULT)
+        self.flush_interval = float(get_scalar_param(
+            block, C.MONITOR_FLUSH_INTERVAL,
+            C.MONITOR_FLUSH_INTERVAL_DEFAULT))
+        if self.flush_interval < 0:
+            raise MonitorConfigError(
+                "monitor.flush_interval must be >= 0 "
+                f"(0 = flush every fence), got {self.flush_interval}")
+        self.stall_timeout_sec = float(get_scalar_param(
+            block, C.MONITOR_STALL_TIMEOUT_SEC,
+            C.MONITOR_STALL_TIMEOUT_SEC_DEFAULT))
+        if self.stall_timeout_sec < 0:
+            raise MonitorConfigError(
+                "monitor.stall_timeout_sec must be >= 0 (0 = off), "
+                f"got {self.stall_timeout_sec}")
+        self.stall_probe = bool(get_scalar_param(
+            block, C.MONITOR_STALL_PROBE, C.MONITOR_STALL_PROBE_DEFAULT))
+        self.all_ranks = bool(get_scalar_param(
+            block, C.MONITOR_ALL_RANKS, C.MONITOR_ALL_RANKS_DEFAULT))

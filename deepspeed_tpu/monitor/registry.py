@@ -1,0 +1,165 @@
+"""Async-safe metrics core.
+
+Hot-path metrics (loss, grad-norm, loss-scale, overflow, tokens) stay
+DEVICE-SIDE between fences: the jitted step functions already compute
+each of them on device, and the registry simply RETAINS those scalar
+buffers (a Python list append — no new dispatch, no host<->device
+sync) until the engine's `steps_per_sync` fence, where everything
+drains in exactly ONE `jax.device_get` of the whole pytree
+(tests/test_monitor.py pins both properties). Retention costs nothing
+on the hot path — unlike a per-step jitted fold, which pays a dispatch
+per step for a 6-float add.
+
+Long fence windows stay bounded: every `_COMPACT_AT` retained steps
+the pending scalars are reduced on device into a 3-scalar partial
+accumulator (a handful of eager jnp dispatches, still no host sync),
+so a steps_per_sync of 100k holds at most _COMPACT_AT+3 scalar
+buffers.
+
+Host-side state splits into:
+  * counters — monotonically increasing floats bumped by host events
+    (checkpoint commits, wire bytes, stall fires); thread-safe, since
+    the checkpoint writer and watchdog threads increment them.
+  * gauges — callables sampled at drain time (checkpoint queue depth,
+    prefetch occupancy, device memory); a gauge may return a float or
+    a flat dict of floats. Gauge failures are swallowed: telemetry
+    must never kill training.
+"""
+
+import threading
+
+import numpy as np
+
+
+class MetricsRegistry:
+    _COMPACT_AT = 256
+
+    def __init__(self):
+        self._pending = []        # [(loss, grad_norm, overflow), ...]
+        self._acc = None          # (loss_sum, gnorm_sum, ovf_sum) device
+        self._scale_last = 0.0    # device scalar or host float
+        self._steps = 0
+        self._loss_steps = 0      # steps that actually reported a loss
+        self._gnorm_steps = 0     # ... and a grad norm
+        self._tokens = 0.0        # host sum (token counts are host ints)
+        self._lock = threading.Lock()
+        self._counters = {}
+        self._gauges = {}
+
+    # ------------------------------------------------------------------
+    # device-side accumulator
+    # ------------------------------------------------------------------
+    def fold_step(self, loss, grad_norm, loss_scale, overflow, tokens):
+        """Retain one step's device scalars. NO device work, NO sync —
+        a list append; the buffers were produced by the step anyway.
+        (Never `bool()`/`float()` a device value here: that would be a
+        hidden per-step sync.)
+
+        A None loss/grad_norm (backward(release_loss=True) loops, paths
+        that skip the norm) folds as 0 on device but is EXCLUDED from
+        the window mean — reporting a bogus 0.0 loss would read as
+        sudden convergence on a dashboard."""
+        self._pending.append((0.0 if loss is None else loss,
+                              0.0 if grad_norm is None else grad_norm,
+                              False if overflow is None else overflow))
+        if loss is not None:
+            self._loss_steps += 1
+        if grad_norm is not None:
+            self._gnorm_steps += 1
+        if loss_scale is not None:
+            self._scale_last = loss_scale
+        self._tokens += float(tokens)
+        self._steps += 1
+        if len(self._pending) >= self._COMPACT_AT:
+            self._compact()
+
+    def _compact(self):
+        """Reduce the pending scalars into the device partial
+        accumulator — a few eager jnp dispatches (async like the step),
+        amortized over _COMPACT_AT steps. Bounds retained buffers for
+        arbitrarily long fence windows."""
+        import jax.numpy as jnp
+        pend, self._pending = self._pending, []
+        losses, gnorms, ovfs = zip(*pend)
+        part = (
+            jnp.sum(jnp.stack(losses).astype(jnp.float32)),
+            jnp.sum(jnp.stack(gnorms).astype(jnp.float32)),
+            jnp.sum(jnp.stack(ovfs).astype(jnp.int32)),
+        )
+        if self._acc is not None:
+            part = tuple(a + p for a, p in zip(self._acc, part))
+        self._acc = part
+
+    # ------------------------------------------------------------------
+    # host-side counters + gauges
+    # ------------------------------------------------------------------
+    def inc(self, name, value=1.0):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + \
+                float(value)
+
+    def set_counter(self, name, value):
+        with self._lock:
+            self._counters[name] = float(value)
+
+    def counters(self):
+        with self._lock:
+            return dict(self._counters)
+
+    def add_gauge(self, name, fn):
+        self._gauges[name] = fn
+
+    def sample_gauges(self):
+        out = {}
+        for name, fn in self._gauges.items():
+            try:
+                val = fn()
+            except Exception:
+                continue
+            if isinstance(val, dict):
+                for k, v in val.items():
+                    out[f"{name}/{k}"] = float(v)
+            elif val is not None:
+                out[name] = float(val)
+        return out
+
+    # ------------------------------------------------------------------
+    # fence drain
+    # ------------------------------------------------------------------
+    def drain_device(self):
+        """ONE device_get of everything retained (partial accumulator +
+        pending scalars + last loss scale, fetched as a single pytree);
+        resets the window. Returns None when nothing was folded since
+        the last drain."""
+        if self._steps == 0:
+            return None
+        import jax
+        acc, pend, scale = jax.device_get(
+            (self._acc, self._pending, self._scale_last))
+        steps, self._steps = self._steps, 0
+        loss_steps, self._loss_steps = self._loss_steps, 0
+        gnorm_steps, self._gnorm_steps = self._gnorm_steps, 0
+        tokens, self._tokens = self._tokens, 0.0
+        self._pending, self._acc = [], None
+
+        loss_sum = gnorm_sum = ovf_sum = 0.0
+        if acc is not None:
+            loss_sum, gnorm_sum, ovf_sum = (float(acc[0]), float(acc[1]),
+                                            float(acc[2]))
+        for loss, gnorm, ovf in pend:
+            loss_sum += float(loss)
+            gnorm_sum += float(gnorm)
+            ovf_sum += float(ovf)
+        scale = float(np.asarray(scale))
+        # loss_scale persists across windows (the next window may hold
+        # only overflow-skipped steps that never touch the scale)
+        self._scale_last = scale
+        return {
+            "steps": int(steps),
+            "loss": loss_sum / loss_steps if loss_steps else None,
+            "grad_norm": gnorm_sum / gnorm_steps if gnorm_steps
+            else None,
+            "loss_scale": scale,
+            "overflow_count": int(ovf_sum),
+            "tokens": int(tokens),
+        }

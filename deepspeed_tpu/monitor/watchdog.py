@@ -1,0 +1,136 @@
+"""Stall watchdog: a background thread that fires when training stops
+making progress.
+
+Progress is defined as the engine's sync fence advancing — the one
+point where host and device provably rendezvous (per-step host activity
+is NOT progress: under async dispatch the host happily queues steps
+against a wedged device until buffer donation blocks it). Subsystems
+that can wedge a run (prefetch worker, checkpoint writer, offload step,
+pipeline compile) report `heartbeat`s; they don't reset the stall clock
+but their ages are included in the diagnostic when the watchdog fires,
+pointing at WHICH part of the pipeline went quiet first.
+
+On fire: one warning log with the per-source age table, an optional
+`on_stall(diag)` callback, an event into the monitor sinks, and —
+with `probe=True` — an `effects_barrier` probe on a separate daemon
+thread (if the barrier returns quickly the device is idle and the
+stall is host-side; if it never returns the device itself is wedged;
+the probe thread is sacrificial so a hung barrier can't wedge the
+watchdog too). The watchdog re-arms after each fire, so a run that
+stalls, recovers, and stalls again reports both episodes.
+"""
+
+import threading
+import time
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class StallWatchdog:
+    def __init__(self, timeout_sec, on_stall=None, probe=False,
+                 emit=None, poll_interval=None):
+        assert timeout_sec > 0, timeout_sec
+        self.timeout_sec = float(timeout_sec)
+        self.on_stall = on_stall
+        self.probe = probe
+        self._emit = emit            # monitor event hook (thread-safe)
+        self._poll = poll_interval or min(self.timeout_sec / 4.0, 5.0)
+        self._lock = threading.Lock()
+        self._last_fence = None      # None = not armed yet
+        self._heartbeats = {}
+        self._fired_for = None       # fence timestamp already reported
+        self.stall_count = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="ds-tpu-watchdog", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # progress signals
+    # ------------------------------------------------------------------
+    def notify_fence(self):
+        """A sync fence advanced — THE progress signal. Also arms the
+        watchdog on first call (an idle engine that never trained must
+        not fire)."""
+        with self._lock:
+            self._last_fence = time.monotonic()
+            self._fired_for = None
+
+    def arm(self):
+        """Start the stall clock without counting progress (called at
+        the first train step, so a first fence that never arrives is
+        itself detected)."""
+        with self._lock:
+            if self._last_fence is None:
+                self._last_fence = time.monotonic()
+
+    def heartbeat(self, source):
+        with self._lock:
+            self._heartbeats[source] = time.monotonic()
+
+    # ------------------------------------------------------------------
+    # the watchdog loop
+    # ------------------------------------------------------------------
+    def _diagnose(self, now, age):
+        with self._lock:
+            beats = dict(self._heartbeats)
+        return {
+            "fence_age_sec": round(age, 3),
+            "timeout_sec": self.timeout_sec,
+            "heartbeat_age_sec": {
+                src: round(now - t, 3) for src, t in beats.items()},
+        }
+
+    def _probe_device(self):
+        """Time an effects_barrier on a sacrificial daemon thread."""
+        def probe():
+            try:
+                import jax
+                t0 = time.monotonic()
+                jax.effects_barrier()
+                logger.warning(
+                    "stall probe: effects_barrier returned in "
+                    f"{time.monotonic() - t0:.3f}s — the device queue is "
+                    "drained; the stall is host-side (input pipeline, "
+                    "checkpoint barrier, or the loop itself)")
+            except Exception as e:
+                logger.warning(f"stall probe failed: {e}")
+
+        threading.Thread(target=probe, name="ds-tpu-stall-probe",
+                         daemon=True).start()
+
+    def _run(self):
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                last = self._last_fence
+                fired = self._fired_for
+            if last is None or fired == last:
+                continue
+            now = time.monotonic()
+            age = now - last
+            if age < self.timeout_sec:
+                continue
+            with self._lock:
+                self._fired_for = last
+                self.stall_count += 1
+            diag = self._diagnose(now, age)
+            logger.warning(
+                f"STALL: no sync fence for {age:.1f}s "
+                f"(stall_timeout_sec={self.timeout_sec}); last subsystem "
+                f"heartbeats (sec ago): {diag['heartbeat_age_sec']}")
+            if self._emit is not None:
+                try:
+                    self._emit("stall", diag)
+                except Exception:
+                    pass
+            if self.probe:
+                self._probe_device()
+            if self.on_stall is not None:
+                try:
+                    self.on_stall(diag)
+                except Exception as e:
+                    logger.warning(f"stall callback raised: {e}")
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)

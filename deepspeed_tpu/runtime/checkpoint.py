@@ -588,6 +588,11 @@ class AsyncCheckpointWriter:
                           if t.is_alive()]
             return list(self._jobs)
 
+    def queue_depth(self):
+        """Saves currently in flight (the monitor's checkpoint
+        queue-depth gauge)."""
+        return len(self._reap())
+
     def _raise_pending(self):
         with self._lock:
             err, self._error = self._error, None

@@ -496,6 +496,8 @@ class DeepSpeedConfig:
 
         self.wall_clock_breakdown = get_wall_clock_breakdown(param_dict)
         self.memory_breakdown = get_memory_breakdown(param_dict)
+        from deepspeed_tpu.monitor.config import DeepSpeedMonitorConfig
+        self.monitor_config = DeepSpeedMonitorConfig(param_dict)
         self.tensorboard_enabled = get_tensorboard_enabled(param_dict)
         self.tensorboard_output_path = get_tensorboard_output_path(param_dict)
         self.tensorboard_job_name = get_tensorboard_job_name(param_dict)

@@ -140,6 +140,35 @@ TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
 #############################################
+# Monitor block (TPU-native extension): unified async-safe telemetry —
+# device-side metric accumulators drained at the async-dispatch sync
+# fences, pluggable sinks (JSONL event log / native tfevents), step
+# tracing, and a stall watchdog. See deepspeed_tpu/monitor/ and
+# docs/monitoring.md.
+#   {"monitor": {"enabled": true, "sinks": ["jsonl", "tensorboard"],
+#                "output_path": "runs/x/monitor", "flush_interval": 0,
+#                "stall_timeout_sec": 120, "stall_probe": false,
+#                "all_ranks": false}}
+#############################################
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_ENABLED_DEFAULT = False
+MONITOR_SINKS = "sinks"
+MONITOR_SINKS_DEFAULT = ("jsonl",)
+MONITOR_OUTPUT_PATH = "output_path"
+MONITOR_OUTPUT_PATH_DEFAULT = ""
+MONITOR_JOB_NAME = "job_name"
+MONITOR_JOB_NAME_DEFAULT = ""
+MONITOR_FLUSH_INTERVAL = "flush_interval"
+MONITOR_FLUSH_INTERVAL_DEFAULT = 0
+MONITOR_STALL_TIMEOUT_SEC = "stall_timeout_sec"
+MONITOR_STALL_TIMEOUT_SEC_DEFAULT = 0
+MONITOR_STALL_PROBE = "stall_probe"
+MONITOR_STALL_PROBE_DEFAULT = False
+MONITOR_ALL_RANKS = "all_ranks"
+MONITOR_ALL_RANKS_DEFAULT = False
+
+#############################################
 # Progressive layer drop
 #############################################
 PROGRESSIVE_LAYER_DROP = "progressive_layer_drop"

@@ -69,15 +69,11 @@ from deepspeed_tpu.runtime.checkpoint import (save_checkpoint_files,
                                               write_latest_tag)
 from deepspeed_tpu.utils.logging import logger, log_dist
 from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from deepspeed_tpu.monitor import (Monitor, SPAN_BACKWARD, SPAN_CKPT,
+                                   SPAN_FORWARD, SPAN_STEP)
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500000000
 
-FORWARD_MICRO_TIMER = "forward_microstep"
-FORWARD_GLOBAL_TIMER = "forward"
-BACKWARD_MICRO_TIMER = "backward_microstep"
-BACKWARD_GLOBAL_TIMER = "backward"
-STEP_MICRO_TIMER = "step_microstep"
-STEP_GLOBAL_TIMER = "step"
 
 
 class EngineState(NamedTuple):
@@ -96,6 +92,17 @@ def _global_norm(tree):
     leaves = [jnp.vdot(x.astype(jnp.float32), x.astype(jnp.float32))
               for x in jax.tree_util.tree_leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
+
+
+def _batch_token_count(batch):
+    """Token/element count of a batch, from the FIRST leaf's static
+    shape — no device access. For token models ([.., b, t] int ids)
+    this is the literal token count; for dense batches it is the
+    element count of the primary input."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        return 0
+    return int(np.prod(np.shape(leaves[0])))
 
 
 def _fetch_to_host(tree):
@@ -195,6 +202,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             batch_size=self.train_micro_batch_size_per_gpu(),
             num_workers=self.dp_world_size,
             steps_per_output=self.steps_per_print())
+        # ---- telemetry (deepspeed_tpu/monitor): device-side metric
+        # accumulators drained at sync fences, pluggable sinks, step
+        # tracing, stall watchdog. Every hot-path hook is one attribute
+        # check when monitor.enabled is false.
+        self.monitor = Monitor(self, self._config.monitor_config)
 
         self.training_dataloader = self.deepspeed_io(training_data) \
             if training_data is not None else None
@@ -207,6 +219,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # gating so the hot loop never blocks on device_get (the device
         # counters remain authoritative for checkpointing).
         self._host_steps = 0
+        # tokens (elements of the first batch leaf) consumed since the
+        # last optimizer step — host int fed to the monitor's
+        # device-side accumulator, no sync
+        self._tokens_pending = 0
+        self._offload_last_norm = None
         # async checkpointing: lazily-built jitted snapshot + writer
         self._ckpt_snapshot_jit = None
         self._ckpt_writer = None
@@ -488,7 +505,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def elasticity_enabled(self):
         return self._config.elasticity_enabled
 
+    _tb_fallback_warned = False
+
     def get_summary_writer(self, name="DeepSpeedJobName", base=None):
+        """TensorBoard writer for the legacy `tensorboard` config block.
+        Served by the native tfevents writer (monitor/tfevents.py) —
+        no torch import anywhere on this path; the config keys
+        (enabled/output_path/job_name) keep their reference meaning.
+        Returns None (warn-once) only when the log dir is unusable."""
         if base is None:
             base = os.path.join(os.path.expanduser("~"), "tensorboard")
         if self.tensorboard_output_path():
@@ -496,12 +520,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         else:
             base_dir = base
         log_dir = os.path.join(base_dir, self.tensorboard_job_name() or name)
-        os.makedirs(log_dir, exist_ok=True)
         try:
-            from torch.utils.tensorboard import SummaryWriter
-            return SummaryWriter(log_dir=log_dir)
-        except Exception as e:  # tensorboard not installed
-            logger.warning(f"tensorboard unavailable: {e}")
+            from deepspeed_tpu.monitor.tfevents import SummaryWriter
+            return SummaryWriter(log_dir)
+        except Exception as e:
+            if not DeepSpeedEngine._tb_fallback_warned:
+                DeepSpeedEngine._tb_fallback_warned = True
+                logger.warning(
+                    f"tensorboard unavailable ({e}); scalar summaries "
+                    "are disabled for this run")
             return None
 
     # ------------------------------------------------------------------
@@ -1370,19 +1397,21 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def forward(self, batch, **kwargs):
         """Compute loss (and cache grads for `backward`)."""
         if self.wall_clock_breakdown():
-            self.timers(FORWARD_MICRO_TIMER).start()
-            self.timers(FORWARD_GLOBAL_TIMER).start()
+            # fence-free span (monitor/trace.py): host dispatch time +
+            # profiler TraceAnnotation, reported at sync fences — the
+            # legacy path barriered the device TWICE per microstep here
+            self.monitor.trace.start(SPAN_FORWARD)
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)
         batch = self._shard_batch(batch)
+        self._tokens_pending += _batch_token_count(batch)
         loss, grads = self._micro_grad_jit(
             self.state.params, batch, self._next_rng(),
             self.state.scale.loss_scale, self._keep_prob())
         self._pending_grads = grads
         self._pending_loss = loss
         if self.wall_clock_breakdown():
-            self.timers(FORWARD_MICRO_TIMER).stop()
-            self.timers(FORWARD_GLOBAL_TIMER).stop()
+            self.monitor.trace.stop(SPAN_FORWARD)
         return loss
 
     __call__ = forward
@@ -1397,8 +1426,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         assert self._pending_grads is not None, \
             "backward() called without a preceding forward()"
         if self.wall_clock_breakdown():
-            self.timers(BACKWARD_MICRO_TIMER).start()
-            self.timers(BACKWARD_GLOBAL_TIMER).start()
+            self.monitor.trace.start(SPAN_BACKWARD)
         if not jax.tree_util.tree_leaves(self.state.acc_grads):
             # gas=1 fast path keeps no persistent accumulator; the first
             # (only) microbatch's grads stand in directly
@@ -1414,8 +1442,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         else:
             self.losses = loss if loss is not None else self._pending_loss
         if self.wall_clock_breakdown():
-            self.timers(BACKWARD_MICRO_TIMER).stop()
-            self.timers(BACKWARD_GLOBAL_TIMER).stop()
+            self.monitor.trace.stop(SPAN_BACKWARD)
         return loss
 
     def _release_pending_loss(self):
@@ -1428,26 +1455,27 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         """Advance one micro step; at the grad-accum boundary, apply the
         model step (ref engine.py:955-1078)."""
         if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).start()
-            self.timers(STEP_GLOBAL_TIMER).start()
+            self.monitor.trace.start(SPAN_STEP)
         if self.is_gradient_accumulation_boundary():
             self._take_model_step(lr_kwargs)
         self.micro_steps += 1
         self._release_pending_loss()
         if self.wall_clock_breakdown():
-            self.timers(STEP_MICRO_TIMER).stop()
-            self.timers(STEP_GLOBAL_TIMER).stop()
-            if self._host_steps % self.steps_per_print() == 0:
-                self.timers.log([
-                    FORWARD_MICRO_TIMER, BACKWARD_MICRO_TIMER,
-                    STEP_MICRO_TIMER
-                ])
+            self.monitor.trace.stop(SPAN_STEP)
 
     def _take_model_step(self, lr_kwargs=None):
         lr = self._host_step_lr()
+        tokens = self._tokens_pending
+        self._tokens_pending = 0
         if self._offload_enabled():
             overflow = self._offload_take_step(lr)
             self._host_steps += 1
+            if self.monitor.enabled:
+                self.monitor.on_step(
+                    loss=self.losses, grad_norm=self._offload_last_norm,
+                    loss_scale=self._host_scaler.cur_scale,
+                    overflow=overflow, tokens=tokens,
+                    wire_stats=self.wire_stats)
             self._after_model_step(jnp.asarray(overflow))
             return
         if self._use_onebit_shardmap and not self._onebit_warned_manual \
@@ -1462,6 +1490,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self._onebit_warned_manual = True
         self.state, overflow, grad_norm = self._apply_jit(self.state, lr)
         self._host_steps += 1
+        if self.monitor.enabled:
+            self.monitor.on_step(
+                loss=self.losses, grad_norm=grad_norm,
+                loss_scale=self.state.scale.loss_scale,
+                overflow=overflow, tokens=tokens)
         self._after_model_step(overflow)
 
     def _next_lr(self):
@@ -1521,6 +1554,24 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         `steps_per_sync` optimizer steps (default: steps_per_print)."""
         self._sync_scheduler_mirror()
         at_print = self._host_steps % self.steps_per_print() == 0
+        spans = None
+        if self.monitor.enabled:
+            # drains the device metric accumulator (ONE device_get per
+            # fence), samples host gauges, emits to sinks, feeds the
+            # stall watchdog
+            event = self.monitor.on_fence()
+            spans = event.get("spans") if event else None
+        elif self.wall_clock_breakdown() and at_print:
+            # wall_clock_breakdown without the monitor block: the trace
+            # still accumulated span times; drain over the full print
+            # window so the flag keeps producing output on its own
+            spans = self.monitor.trace.drain()
+        if at_print and spans:
+            log_dist(
+                "span ms/step (host dispatch, fence-aligned) | " +
+                " | ".join(f"{k}: {v['ms_per']:.2f}"
+                           for k, v in spans.items()),
+                ranks=[0])
         if self.summary_writer is not None and at_print:
             gs = self.global_steps
             samples = gs * self.train_batch_size()
@@ -1535,6 +1586,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 self.summary_writer.add_scalar(
                     "Train/Samples/loss_scale", self.loss_scale(),
                     samples)
+            # the native writer buffers via the file object; make the
+            # scalars visible to a live TensorBoard at print cadence
+            self.summary_writer.flush()
         if at_print:
             # _current_lr, not get_lr(): the mirror was synced above and
             # get_lr() would pay a second device round trip for it
@@ -1569,10 +1623,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         `depth` (default async_dispatch.prefetch_depth) staged batches
         ahead of the step loop. Feed the result to `train_batch` as
         `data_iter`."""
-        return PrefetchLoader(
+        loader = PrefetchLoader(
             data_source, stage_fn=self.stage_batch, gas=self._jit_gas(),
             depth=depth if depth is not None else self.prefetch_depth(),
-            stacked=stacked)
+            stacked=stacked,
+            heartbeat=(lambda: self.monitor.heartbeat("prefetch"))
+            if self.monitor.enabled else None)
+        # queue-occupancy gauge + stall-diagnosis heartbeats ride the
+        # live loader
+        self.monitor.attach_prefetch(loader)
+        return loader
 
     def train_batch(self, data_iter=None, batch=None):
         """Fast path: one fused jitted step over all grad-accum
@@ -1597,12 +1657,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         self.tput_timer.start()
         batch = self.stage_batch(batch)
+        tokens = _batch_token_count(batch)
         lr = self._host_step_lr()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)
         if self.flops_profiler_enabled() and \
                 self._host_steps + 1 == self.flops_profiler_profile_step():
             self._profile_fused_step(batch, lr)
+        if self.wall_clock_breakdown():
+            self.monitor.trace.start(SPAN_STEP)
         if self._offload_enabled():
             self.state, loss = self._offload_grads_jit(
                 self.state, batch, self._next_rng(), self._keep_prob())
@@ -1634,11 +1697,25 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     step_fn = self._onebit_compressed_jit
             self.state, loss, overflow, grad_norm = step_fn(
                 self.state, batch, self._next_rng(), lr, self._keep_prob())
+        if self.wall_clock_breakdown():
+            self.monitor.trace.stop(SPAN_STEP)
         mbs = self._microbatches_per_step()
         self.micro_steps += mbs
         self._host_steps += 1
         # losses before the fence: _sync_fence logs THIS step's loss
         self.losses = loss
+        if self.monitor.enabled:
+            if self._offload_enabled():
+                self.monitor.on_step(
+                    loss=loss, grad_norm=self._offload_last_norm,
+                    loss_scale=self._host_scaler.cur_scale,
+                    overflow=overflow, tokens=tokens,
+                    wire_stats=self.wire_stats)
+            else:
+                self.monitor.on_step(
+                    loss=loss, grad_norm=grad_norm,
+                    loss_scale=self.state.scale.loss_scale,
+                    overflow=overflow, tokens=tokens)
         self._after_model_step(overflow)
         # one fused step consumed `mbs` microbatches worth of samples
         self.tput_timer.stop(count=mbs)
@@ -1837,6 +1914,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         update `latest` LAST, then rotate per checkpoint.keep_last.
         `commit_gate` (from AsyncCheckpointWriter.submit) orders the
         commit sections of concurrent writers by submission."""
+        import time as _time
+        write_t0 = _time.perf_counter()
+        self.monitor.heartbeat("checkpoint")
         multi_proc = jax.process_count() > 1
 
         def _barrier(phase):
@@ -1900,6 +1980,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         log_dist("checkpoint rotation removed "
                                  f"{deleted}", ranks=[0])
         _barrier("committed")
+        if self.monitor.enabled:
+            # runs on the writer thread under async_save — the monitor
+            # event path and counters are thread-safe by contract
+            commit_ms = (_time.perf_counter() - write_t0) * 1e3
+            self.monitor.registry.inc("ckpt/commits")
+            self.monitor.registry.set_counter("ckpt/last_commit_ms",
+                                              round(commit_ms, 2))
+            self.monitor.heartbeat("checkpoint")
+            self.monitor.event(
+                "ckpt_commit", tag=str(tag), dir=save_dir,
+                wall_ms=round(commit_ms, 2),
+                global_steps=int(gs) + int(sk))
         log_dist(f"saved checkpoint {tag} to {save_dir}", ranks=[0])
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
@@ -1941,8 +2033,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             # memcpys it is dropping
             if not self._ckpt_writer.admit(tag):
                 return False
-        snap = self._checkpoint_snapshot(client_state,
-                                         isolate=async_save)
+        with self.monitor.trace.span(SPAN_CKPT):
+            # the only part of an async save the train loop pays for
+            snap = self._checkpoint_snapshot(client_state,
+                                             isolate=async_save)
         if not async_save:
             # an in-flight async writer may hold this tag's staging dir
             # or commit `latest` after us — drain it before an inline

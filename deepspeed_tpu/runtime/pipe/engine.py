@@ -291,6 +291,10 @@ class PipelineEngine(DeepSpeedEngine):
                     f"for {self._interp_sig}")
             return
         self._interp_sig = self._batch_sig(stacked_batch)
+        # a multi-minute 1F1B compile is indistinguishable from a hang
+        # without this: the stall diagnostic shows a fresh "compile"
+        # heartbeat instead of a dead engine
+        self.monitor.heartbeat("compile")
         from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
         self._interp_fn = build_pipeline_step(
             module=self.module, mesh=self.mesh,

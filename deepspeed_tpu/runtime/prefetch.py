@@ -49,15 +49,20 @@ class PrefetchLoader:
       gas: microbatches collated per stacked batch (ignored when
         ``stacked=True``).
       depth: max staged batches in flight ahead of the consumer.
+      heartbeat: optional zero-arg callable invoked after each staged
+        batch (the monitor's stall-watchdog heartbeat — a quiet
+        prefetch worker shows up by age in the stall diagnostic).
     """
 
     def __init__(self, source, stage_fn=None, gas=1, depth=2,
-                 stacked=False):
+                 stacked=False, heartbeat=None):
         self._source = source
         self._stage_fn = stage_fn
         self._gas = max(1, int(gas))
         self._stacked = stacked
-        self._queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._heartbeat = heartbeat
+        self.depth = max(1, int(depth))
+        self._queue = queue.Queue(maxsize=self.depth)
         self._exc = None
         self._closed = False
         self._thread = threading.Thread(
@@ -88,6 +93,11 @@ class PrefetchLoader:
                 if self._stage_fn is not None:
                     batch = self._stage_fn(batch)
                 self._put(batch)
+                if self._heartbeat is not None:
+                    try:
+                        self._heartbeat()
+                    except Exception:
+                        pass
         except BaseException as e:  # surfaced on the consumer side
             self._exc = e
         finally:
@@ -125,6 +135,12 @@ class PrefetchLoader:
                 raise exc
             raise StopIteration
         return item
+
+    def occupancy(self):
+        """Staged batches currently queued ahead of the consumer (the
+        monitor's prefetch gauge: 0 at a fence means the input pipeline
+        is the bottleneck; == depth means the step loop is)."""
+        return self._queue.qsize()
 
     def close(self):
         """Stop the worker and drop queued batches."""

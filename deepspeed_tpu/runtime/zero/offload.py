@@ -431,6 +431,11 @@ class ZeroOffloadMixin:
             flat, norm = self._offload_grad_tail_jit(
                 self.state.acc_grads, self.state.scale.loss_scale)
         norm_host = float(jax.device_get(norm))
+        # feeds the monitor (grad_norm metric + stall diagnosis): the
+        # offload step is the one host-synchronous engine path, so the
+        # norm is already on host for free
+        self._offload_last_norm = norm_host
+        self.monitor.heartbeat("offload")
         overflow = not np.isfinite(norm_host)
         self._host_scaler.update_scale(overflow)
         new_scale = make_static_loss_scale_state(

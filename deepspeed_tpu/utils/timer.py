@@ -11,6 +11,29 @@ import time
 from deepspeed_tpu.utils.logging import log_dist
 
 
+def device_memory_stats():
+    """Aggregate allocator stats over ALL local devices — sum of
+    bytes-in-use (what this process holds), max of peak-bytes-in-use
+    (the binding per-chip high-water mark; summing peaks would
+    overstate a single chip's pressure). device_count=0 means the
+    backend exposes no memory_stats (e.g. some CPU runtimes); the
+    monitor's memory gauge publishes the same three numbers."""
+    in_use, peak, count = 0, 0, 0
+    try:
+        import jax
+        for dev in jax.local_devices():
+            stats = dev.memory_stats() or {}
+            if not stats:
+                continue
+            in_use += int(stats.get("bytes_in_use", 0))
+            peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+            count += 1
+    except Exception:
+        pass
+    return {"in_use_bytes": in_use, "peak_bytes": peak,
+            "device_count": count}
+
+
 def _device_sync():
     try:
         import jax
@@ -76,15 +99,14 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage():
-        try:
-            import jax
-            stats = jax.local_devices()[0].memory_stats() or {}
-            in_use = stats.get("bytes_in_use", 0)
-            peak = stats.get("peak_bytes_in_use", 0)
-            return (f"DeviceMemInUse={round(in_use / (1024 * 1024 * 1024), 2)} GB | "
-                    f"DevicePeak={round(peak / (1024 * 1024 * 1024), 2)} GB")
-        except Exception:
+        stats = device_memory_stats()
+        if not stats["device_count"]:
             return "DeviceMem=unavailable"
+        gib = 1024 ** 3
+        return (f"DeviceMemInUse={round(stats['in_use_bytes'] / gib, 2)}"
+                f" GB | DevicePeak="
+                f"{round(stats['peak_bytes'] / gib, 2)} GB "
+                f"(over {stats['device_count']} local devices)")
 
     def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
         assert normalizer > 0.0

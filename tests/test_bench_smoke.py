@@ -60,6 +60,32 @@ def test_bench_only_async_checkpoint_leg():
     assert result["save_call_speedup"] > 1
 
 
+def test_bench_only_monitor_overhead_leg():
+    """The telemetry overhead A/B (ISSUE 5) must run end-to-end via
+    `--only`: monitor-on vs monitor-off interleaved windows, the
+    <3% overhead contract, and the shared snapshot() schema."""
+    proc = _bench_proc("--only", "monitor_overhead", timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    d = json.loads(line)
+    assert d["leg"] == "monitor_overhead"
+    result = d["result"]
+    assert "error" not in result, result
+    for leg in ("off", "on"):
+        assert "steps_per_sec" in result[leg]
+        assert "step_ms" in result[leg]
+    assert "overhead_pct" in result
+    # the acceptance contract: telemetry costs < 3% of step time
+    assert result["regressed"] is False, result
+    # bench extras share the training telemetry schema via snapshot()
+    snap = result["snapshot"]
+    for key in ("loss", "lr", "samples_per_sec", "tokens",
+                "overflow_count"):
+        assert key in snap
+    # the JSONL sink recorded fences during the measured windows
+    assert result["jsonl_metric_events"] > 0
+
+
 def test_bench_only_unknown_leg_fails_with_list():
     proc = _bench_proc("--only", "no_such_leg")
     assert proc.returncode != 0
