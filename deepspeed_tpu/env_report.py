@@ -122,6 +122,15 @@ def feature_report():
                      f"{SUCCESS} interpret mode (no TPU attached)"))
     except Exception as e:
         rows.append(("Pallas flash attention", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.ops.transformer.fused_ops import \
+            fused_ops_available
+        ok, mode = fused_ops_available()
+        rows.append(("Pallas fused ops",
+                     f"{SUCCESS} {mode} (bias+residual+LayerNorm, "
+                     "bias+GeLU)" if ok else f"{FAIL} {mode}"))
+    except Exception as e:
+        rows.append(("Pallas fused ops", f"{FAIL} {e}"))
 
     print("-" * 64)
     print("runtime feature report")

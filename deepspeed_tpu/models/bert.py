@@ -44,6 +44,12 @@ class BertConfig:
     # is d=64 (1024/16), so "auto" packs two heads per grid step into
     # K=128 contractions on real TPU.
     attention_head_packing: str = "auto"
+    # Fused non-attention epilogues ("auto"|"on"|"off"), forwarded to
+    # DeepSpeedTransformerConfig.fused_ops: bias+residual+LayerNorm and
+    # bias+exact-erf-GeLU as single Pallas launches
+    # (ops/transformer/fused_ops.py). "auto" fuses on real TPU when
+    # hidden dropout is inactive; the parameter tree is unchanged.
+    fused_ops: str = "auto"
     # Run the MLM head (transform + vocab decoder) matmuls in the
     # compute dtype instead of fp32. The [hidden, vocab] decoder
     # projection is ~10% of the model's flops; in fp32 it runs at a
@@ -95,6 +101,7 @@ def _ds_layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
         attn_dropout_checkpoint=cfg.attn_dropout_checkpoint,
         layer_norm_eps=cfg.layer_norm_eps,
         head_packing=cfg.attention_head_packing,
+        fused_ops=cfg.fused_ops,
         training=True)
 
 

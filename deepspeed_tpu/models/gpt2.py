@@ -52,6 +52,16 @@ class GPT2Config:
     # K=128 (the MXU's native width; unpacked d=64 runs half-starved),
     # "packed"/"off" force it. Odd B*H counts pad one zero row.
     attention_head_packing: str = "auto"
+    # Fused non-attention epilogues ("auto"|"on"|"off"): the block's
+    # c_proj-bias + residual + ln_2 chain and the c_fc-bias + GeLU run
+    # as single Pallas launches with a one-pass custom backward
+    # (ops/transformer/fused_ops.py). "auto" fuses on real TPU when
+    # dropout is inactive (backend-keyed like attention_head_packing);
+    # "on" forces the path anywhere (XLA fallback off-TPU — same custom
+    # VJP, same checkpoint names). The parameter tree is identical
+    # either way. Pairs with remat_policy="save_fused_epilogues" for
+    # per-fusion rematerialisation.
+    fused_ops: str = "auto"
     # Sequence/context parallelism for long sequences: shard T over a
     # mesh axis and run ring (ppermute KV rotation) or ulysses
     # (all-to-all head swap) attention. Set sp_mesh to the engine mesh
@@ -94,16 +104,14 @@ def gpt2_config(name="gpt2-125m", **overrides) -> GPT2Config:
 
 
 def resolve_remat_policy(name):
-    """Remat-policy string -> jax policy. Plain names resolve from
-    `jax.checkpoint_policies`; `"save_only_these_names:a,b"` builds the
-    named-checkpoint policy over `checkpoint_name` annotations (the
-    model marks its attention output as "attn_out")."""
-    if name is None:
-        return None
-    if name.startswith("save_only_these_names:"):
-        names = [n for n in name.split(":", 1)[1].split(",") if n]
-        return jax.checkpoint_policies.save_only_these_names(*names)
-    return getattr(jax.checkpoint_policies, name)
+    """Remat-policy string -> jax policy. Registered custom policies
+    (incl. the built-in "save_fused_epilogues" per-fusion policy)
+    resolve first, then `"save_only_these_names:a,b"` over
+    `checkpoint_name` annotations (the model marks its attention output
+    as "attn_out"), then `jax.checkpoint_policies` attributes."""
+    from deepspeed_tpu.runtime.activation_checkpointing.checkpointing \
+        import resolve_checkpoint_policy
+    return resolve_checkpoint_policy(name)
 
 
 def _dense(features, config, name, init_scale=1.0):
@@ -183,10 +191,24 @@ class GPT2Block(nn.Module):
         cfg = self.config
         b, t, c = hidden.shape
 
+        from deepspeed_tpu.ops.transformer.fused_ops import (
+            fused_bias_gelu, fused_bias_residual_layernorm,
+            resolve_fused_ops)
+        # dropout sits between each projection's bias and the residual,
+        # so the fused epilogues require it inactive
+        use_fused = resolve_fused_ops(
+            cfg.fused_ops, deterministic or cfg.dropout == 0.0)
+
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
                            param_dtype=cfg.param_dtype, name="ln_1")
-        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
-                           param_dtype=cfg.param_dtype, name="ln_2")
+        if use_fused:
+            from deepspeed_tpu.ops.transformer.transformer import LNParams
+            ln2_p = LNParams(param_dtype=cfg.param_dtype,
+                             name="ln_2")(cfg.n_embd)
+        else:
+            ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                               dtype=jnp.float32,
+                               param_dtype=cfg.param_dtype, name="ln_2")
 
         # --- attention ---
         x = ln1(hidden).astype(cfg.dtype)
@@ -206,6 +228,31 @@ class GPT2Block(nn.Module):
         # (+1 fwd of recompute) and dots_saveable (~235 MB/layer, OOM).
         attn = _attention(cfg, q, k, v, drop_rng, deterministic)
         attn = attn.reshape(b, t, cfg.n_embd)
+        if use_fused:
+            from deepspeed_tpu.ops.transformer.transformer import \
+                SplitDense
+            proj_init = nn.initializers.normal(
+                cfg.initializer_range / np.sqrt(2 * cfg.n_layer))
+            attn_y, attn_b = SplitDense(
+                cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+                kernel_init=proj_init, name="c_proj")(attn)
+            # one launch: c_proj bias + residual + ln_2; `hidden`
+            # carries on un-normalized (pre-LN)
+            y, hidden = fused_bias_residual_layernorm(
+                attn_y, attn_b, hidden, *ln2_p,
+                eps=cfg.layer_norm_epsilon, out_dtype=cfg.dtype,
+                sum_dtype=jnp.result_type(hidden.dtype, cfg.dtype))
+            fc_y, fc_b = SplitDense(
+                4 * cfg.n_embd, dtype=cfg.dtype,
+                param_dtype=cfg.param_dtype,
+                kernel_init=nn.initializers.normal(
+                    cfg.initializer_range), name="c_fc")(y)
+            # GPT-2 uses the tanh GeLU approximation
+            y = fused_bias_gelu(fc_y, fc_b, approximate=True,
+                                out_dtype=cfg.dtype)
+            y = _dense(cfg.n_embd, cfg, "mlp_c_proj",
+                       init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
+            return hidden + y
         # proj init scaled down by depth (GPT-2 residual-scaling trick)
         attn = _dense(cfg.n_embd, cfg, "c_proj",
                       init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(attn)

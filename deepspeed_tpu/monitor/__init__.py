@@ -170,6 +170,33 @@ class Monitor:
             "last_commit_ms": counters.get("ckpt/last_commit_ms"),
         }
 
+    def _throughput_derived(self):
+        """tokens/s/chip + MFU once the throughput timer has a warmed
+        measurement window (None before that, and MFU None off-TPU
+        where no nominal peak applies).  Same convention as bench.py's
+        headline: conservative 6·N·tokens/s against the chip's nominal
+        bf16 peak — MFU becomes observable IN-LOOP instead of
+        bench-only."""
+        e = self._engine_ref()
+        if e is None:
+            return {"tokens_per_sec_per_chip": None, "mfu": None}
+        sps = e.tput_timer.avg_samples_per_sec()
+        t_per_sample = getattr(e, "_tokens_per_sample", None)
+        if not sps or not t_per_sample:
+            return {"tokens_per_sec_per_chip": None, "mfu": None}
+        import jax
+        tps_chip = sps * t_per_sample / max(len(jax.devices()), 1)
+        mfu = None
+        n = getattr(e, "_n_model_params", 0)
+        if n and jax.devices()[0].platform == "tpu":
+            from deepspeed_tpu.profiling.flops_profiler.profiler import \
+                device_peak_specs
+            peak, _ = device_peak_specs()
+            if peak:
+                mfu = round(6.0 * n * tps_chip / peak, 4)
+        return {"tokens_per_sec_per_chip": round(tps_chip, 1),
+                "mfu": mfu}
+
     def on_fence(self):
         """The ONE telemetry rendezvous: drain the device accumulator
         (a single device_get), sample host gauges, emit a metrics
@@ -211,6 +238,7 @@ class Monitor:
             tokens=self._cum["tokens"],
             samples_per_sec=round(e.tput_timer.avg_samples_per_sec(), 3),
         )
+        event.update(self._throughput_derived())
         if self._last_fence_t is not None and now > self._last_fence_t:
             event["tokens_per_sec"] = round(
                 window["tokens"] / (now - self._last_fence_t), 1)
@@ -269,7 +297,8 @@ class Monitor:
     SNAPSHOT_KEYS = (
         "schema", "enabled", "step", "micro_steps", "loss", "grad_norm",
         "loss_scale", "lr", "overflow_count", "tokens",
-        "samples_per_sec", "memory", "wire", "checkpoint", "prefetch",
+        "samples_per_sec", "tokens_per_sec_per_chip", "mfu",
+        "memory", "wire", "checkpoint", "prefetch",
     )
 
     def snapshot(self):
@@ -305,6 +334,7 @@ class Monitor:
             "samples_per_sec":
                 round(e.tput_timer.avg_samples_per_sec(), 3) if e
                 else None,
+            **self._throughput_derived(),
             "memory": {
                 k.split("/", 1)[1]: v for k, v in gauges.items()
                 if k.startswith("memory/")},

@@ -2,6 +2,10 @@ from deepspeed_tpu.ops.transformer.transformer import (
     DeepSpeedTransformerLayer, DeepSpeedTransformerConfig)
 from deepspeed_tpu.ops.transformer.flash_attention import (
     flash_attention, flash_attention_usable)
+from deepspeed_tpu.ops.transformer.fused_ops import (
+    fused_bias_gelu, fused_bias_residual_layernorm, resolve_fused_ops)
 
 __all__ = ["DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
-           "flash_attention", "flash_attention_usable"]
+           "flash_attention", "flash_attention_usable",
+           "fused_bias_gelu", "fused_bias_residual_layernorm",
+           "resolve_fused_ops"]

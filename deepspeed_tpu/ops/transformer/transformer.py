@@ -32,6 +32,59 @@ import jax.numpy as jnp
 import numpy as np
 
 
+class SplitDense(nn.Module):
+    """nn.Dense-compatible parameters (same "kernel"/"bias" names,
+    shapes and initializers — checkpoints interchange freely) that
+    returns `(x @ kernel, bias)` instead of adding the bias, so the
+    bias rides a fused epilogue kernel (ops/transformer/fused_ops.py)
+    together with the residual/LayerNorm or GeLU that follows."""
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          self.param_dtype)
+        x = x.astype(self.dtype)
+        y = jax.lax.dot_general(x, kernel.astype(self.dtype),
+                                (((x.ndim - 1,), (0,)), ((), ())))
+        return y, bias
+
+
+class LNParams(nn.Module):
+    """LayerNorm-compatible "scale"/"bias" parameters without applying
+    the norm — the fused bias+residual+LayerNorm kernel applies it."""
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features):
+        scale = self.param("scale", nn.initializers.ones, (features,),
+                           self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (features,),
+                          self.param_dtype)
+        return scale, bias
+
+
+def plain_layernorm(x, scale, bias, eps):
+    """flax nn.LayerNorm(dtype=fp32) numerics off raw scale/bias params
+    (fast-variance formula, variance clamped >= 0 — fp32 roundoff on
+    near-constant rows can drive E[x^2]-E[x]^2 negative past eps and
+    rsqrt of that is NaN), for the LN applications the fused chain
+    does not cover (e.g. the pre-LN block's leading norm).  Same
+    formula as fused_ops._ln_stats."""
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.maximum(
+        jnp.mean(x32 * x32, axis=-1, keepdims=True) - mu * mu, 0.0)
+    return (x32 - mu) * jax.lax.rsqrt(var + eps) * \
+        scale.astype(jnp.float32) + bias.astype(jnp.float32)
+
+
 class DeepSpeedTransformerConfig:
     """Config parity with ref `ops/transformer/transformer.py:39-154`."""
 
@@ -58,7 +111,8 @@ class DeepSpeedTransformerConfig:
                  training=True,
                  bf16=False,
                  layer_norm_eps=1e-12,
-                 head_packing="auto"):
+                 head_packing="auto",
+                 fused_ops="auto"):
         self.batch_size = batch_size
         self.max_seq_length = max_seq_length
         self.hidden_size = hidden_size
@@ -90,6 +144,14 @@ class DeepSpeedTransformerConfig:
         # score/output matmuls contract over K=128 instead of running
         # the MXU half-starved at K=64 (flash_attention.py docstring).
         self.head_packing = head_packing
+        # Fused non-attention epilogues ("auto"|"on"|"off"): the
+        # bias+residual+LayerNorm and bias+GeLU chains run as single
+        # Pallas launches with a one-pass custom backward
+        # (ops/transformer/fused_ops.py). "auto" fuses on real TPU when
+        # hidden dropout is inactive; "on" forces the fused path (XLA
+        # fallback off-TPU — same custom VJP, same remat names); the
+        # parameter tree is identical either way.
+        self.fused_ops = fused_ops
 
     @classmethod
     def from_dict(cls, json_object):
@@ -135,17 +197,44 @@ class _TransformerLayerCore(nn.Module):
                             param_dtype=jnp.float32,
                             kernel_init=kernel_init, name=name)
 
-        ln_attn = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
-                               dtype=jnp.float32, param_dtype=jnp.float32,
-                               name="attn_layer_norm")
-        ln_out = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
-                              dtype=jnp.float32, param_dtype=jnp.float32,
-                              name="layer_norm")
+        from deepspeed_tpu.ops.transformer.fused_ops import (
+            fused_bias_gelu, fused_bias_residual_layernorm,
+            resolve_fused_ops)
+        # hidden dropout sits between the bias add and the residual, so
+        # the fused chain requires it inactive ("auto" checks exactly
+        # this; attention dropout is inside the attention op and does
+        # not constrain the epilogues)
+        use_fused = resolve_fused_ops(
+            cfg.fused_ops,
+            deterministic or cfg.hidden_dropout_ratio == 0.0)
+
+        if use_fused:
+            ln_attn_p = LNParams(name="attn_layer_norm")(h)
+            ln_out_p = LNParams(name="layer_norm")(h)
+
+            def split_dense(features, name, kernel_init=init):
+                return SplitDense(features, dtype=compute_dtype,
+                                  param_dtype=jnp.float32,
+                                  kernel_init=kernel_init, name=name)
+        else:
+            ln_attn = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                   dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   name="attn_layer_norm")
+            ln_out = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                                  dtype=jnp.float32,
+                                  param_dtype=jnp.float32,
+                                  name="layer_norm")
 
         # ---- attention ----
         x = hidden_states
-        attn_input = ln_attn(x).astype(compute_dtype) \
-            if cfg.pre_layer_norm else x.astype(compute_dtype)
+        if cfg.pre_layer_norm:
+            attn_input = (plain_layernorm(x, *ln_attn_p,
+                                          eps=cfg.layer_norm_eps)
+                          if use_fused else ln_attn(x)) \
+                .astype(compute_dtype)
+        else:
+            attn_input = x.astype(compute_dtype)
         qkv = dense(3 * h, "attn_qkvw")(attn_input)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, nh, hd)
@@ -154,16 +243,50 @@ class _TransformerLayerCore(nn.Module):
 
         ctx = self._attention(q, k, v, attention_mask, deterministic)
         ctx = ctx.reshape(b, t, h)
-        attn_out = dense(h, "attn_ow", kernel_init=out_init)(ctx)
-        attn_out = nn.Dropout(cfg.hidden_dropout_ratio)(
-            attn_out, deterministic=deterministic)
-        x = x + attn_out
-        if not cfg.pre_layer_norm:
-            x = ln_attn(x)
+        if use_fused:
+            attn_y, attn_b = split_dense(h, "attn_ow",
+                                         kernel_init=out_init)(ctx)
+            if cfg.pre_layer_norm:
+                # one launch: attn_ow bias + residual + the MLP's
+                # pre-norm; `x` carries on un-normalized
+                mlp_input, x = fused_bias_residual_layernorm(
+                    attn_y, attn_b, x, *ln_out_p,
+                    eps=cfg.layer_norm_eps, out_dtype=compute_dtype,
+                    sum_dtype=jnp.result_type(x.dtype, compute_dtype))
+            else:
+                # post-LN: the normalized sum IS the carry
+                # (return_sum=False: single-output primal — no zeros
+                # cotangent rides the backward kernel)
+                x = fused_bias_residual_layernorm(
+                    attn_y, attn_b, x, *ln_attn_p,
+                    eps=cfg.layer_norm_eps, out_dtype=jnp.float32,
+                    return_sum=False)
+                mlp_input = x.astype(compute_dtype)
+        else:
+            attn_out = dense(h, "attn_ow", kernel_init=out_init)(ctx)
+            attn_out = nn.Dropout(cfg.hidden_dropout_ratio)(
+                attn_out, deterministic=deterministic)
+            x = x + attn_out
+            if not cfg.pre_layer_norm:
+                x = ln_attn(x)
+            mlp_input = (ln_out(x) if cfg.pre_layer_norm else x) \
+                .astype(compute_dtype)
 
         # ---- MLP ----
-        mlp_input = ln_out(x).astype(compute_dtype) \
-            if cfg.pre_layer_norm else x.astype(compute_dtype)
+        if use_fused:
+            inter_y, inter_b = split_dense(cfg.intermediate_size,
+                                           "inter_w")(mlp_input)
+            inter = fused_bias_gelu(inter_y, inter_b, approximate=False,
+                                    out_dtype=compute_dtype)
+            if cfg.pre_layer_norm:
+                mlp_out = dense(h, "output_w",
+                                kernel_init=out_init)(inter)
+                return x + mlp_out
+            mlp_y, mlp_b = split_dense(h, "output_w",
+                                       kernel_init=out_init)(inter)
+            return fused_bias_residual_layernorm(
+                mlp_y, mlp_b, x, *ln_out_p, eps=cfg.layer_norm_eps,
+                out_dtype=jnp.float32, return_sum=False)
         inter = dense(cfg.intermediate_size, "inter_w")(mlp_input)
         inter = nn.gelu(inter, approximate=False)
         mlp_out = dense(h, "output_w", kernel_init=out_init)(inter)
@@ -215,7 +338,23 @@ class DeepSpeedTransformerLayer(nn.Module):
             # Save only the block inputs; recompute LN/GELU/attention
             # context in the backward pass (the memory the reference's
             # normalize_invertible / gelu_checkpoint /
-            # attn_dropout_checkpoint flags reclaim).
-            core = nn.remat(core, prevent_cse=False, static_argnums=(3,))
+            # attn_dropout_checkpoint flags reclaim).  With fused ops
+            # active, remat is PER-FUSION instead: the
+            # save_fused_epilogues policy keeps the fused kernels'
+            # named outputs, so the backward recompute skips the
+            # attention forward and every fused chain (tuned from the
+            # roofline's bytes/flops verdicts —
+            # runtime/activation_checkpointing/checkpointing.py).
+            from deepspeed_tpu.ops.transformer.fused_ops import \
+                resolve_fused_ops
+            policy = None
+            if resolve_fused_ops(cfg.fused_ops,
+                                 deterministic or
+                                 cfg.hidden_dropout_ratio == 0.0):
+                from deepspeed_tpu.runtime.activation_checkpointing \
+                    .checkpointing import resolve_checkpoint_policy
+                policy = resolve_checkpoint_policy("save_fused_epilogues")
+            core = nn.remat(core, prevent_cse=False, static_argnums=(3,),
+                            policy=policy)
         return core(cfg, dtype, name="core")(
             hidden_states, attention_mask, deterministic)

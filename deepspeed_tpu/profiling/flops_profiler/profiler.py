@@ -255,35 +255,86 @@ def _sliced_fusion_bytes(body):
     return reads + writes
 
 
+def device_peak_specs(device=None):
+    """(peak_bf16_flops, hbm_GBps) for the current/given device from
+    the nominal spec table; a generic 100 TF / 800 GB/s off-table
+    (rankings and time_pct are scale-free either way).  Unknown
+    backends (CPU) return the generic numbers; callers that need "no
+    peak known" semantics (MFU) should check the platform first."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    table = {"v4": (275e12, 1228.0), "v5 lite": (197e12, 819.0),
+             "v5e": (197e12, 819.0), "v5p": (459e12, 2765.0),
+             "v6": (918e12, 1640.0)}
+    for k, (p, b) in table.items():
+        if k in kind:
+            return p, b
+    return 100e12, 800.0
+
+
+# Pallas kernels appear in optimized HLO as custom-calls; the kernel
+# identity lives in the op_name metadata (the jaxpr scope path, which
+# includes any jax.named_scope the op wrapper opened and the
+# pallas_call frame) and, failing that, the custom_call_target.
+_PALLAS_NAME_RE = re.compile(r"pallas_call\[?[^\]\"]*?name=([\w./\-]+)")
+
+
+def _custom_call_label(line):
+    """Best-effort kernel label for a custom-call HLO line: the Pallas
+    kernel name out of op_name metadata (`pallas_call[... name=...]`,
+    or the innermost non-pallas scope segment — e.g. the
+    jax.named_scope the fused-ops wrappers open), else the
+    custom_call_target."""
+    mo = re.search(r'op_name="([^"]+)"', line)
+    if mo:
+        op = mo.group(1)
+        mk = _PALLAS_NAME_RE.search(op)
+        if mk:
+            return mk.group(1)
+        if "pallas_call" in op:
+            segs = [s for s in op.split("/")
+                    if s and "pallas_call" not in s
+                    and not s.startswith(("jit(", "jvp(", "transpose("))]
+            if segs:
+                return segs[-1]
+    mt = re.search(r'custom_call_target="([^"]+)"', line)
+    return mt.group(1) if mt else None
+
+
 def per_fusion_costs(fn, *args, peak_flops=None, hbm_gbps=None, **kwargs):
     """Roofline time breakdown of `fn(*args)`'s optimized HLO, one row
     per top-level fusion / custom-call (Pallas kernel) / bare dot.
 
     Returns rows sorted by estimated time, each
-    {name, op, kind, flops, bytes, transcendentals, calls, est_us,
-    time_pct}: `op` is the semantic op_name metadata (model-layer
-    path), `calls` the executed multiplicity (propagated through
-    call/while nesting; a while whose trip count the compiler did not
-    record counts as 1 and the row says so via calls=1). est_us =
-    max(flops/peak, bytes/bw [, transcendental time]) — an ESTIMATE
-    for ranking sinks, not a measurement; custom-calls have no visible
-    flops, so theirs is bytes-only (a lower bound).
+    {name, op, kind, kernel, flops, bytes, transcendentals, calls,
+    est_us, time_pct}: `op` is the semantic op_name metadata
+    (model-layer path), `kernel` the resolved kernel label for
+    custom-calls (Pallas kernel name / named_scope / call target — so
+    the fused epilogue and flash kernels are attributable instead of
+    an opaque "custom-call"), `calls` the executed multiplicity
+    (propagated through call/while nesting; a while whose trip count
+    the compiler did not record counts as 1 and the row says so via
+    calls=1). est_us = max(flops/peak, bytes/bw [, transcendental
+    time]) — an ESTIMATE for ranking sinks, not a measurement;
+    custom-calls have no visible flops, so theirs is bytes-only (a
+    lower bound).
 
     peak_flops/hbm_gbps default to the current device's nominal specs
     when known (v4/v5e/v5p table) else a generic 100 TF / 800 GB/s —
     the ranking and time_pct are scale-free either way."""
     jitted = fn if isinstance(fn, jax.stages.Wrapped) else jax.jit(fn)
     text = jitted.lower(*args, **kwargs).compile().as_text()
+    return per_fusion_costs_from_text(text, peak_flops=peak_flops,
+                                      hbm_gbps=hbm_gbps)
+
+
+def per_fusion_costs_from_text(text, peak_flops=None, hbm_gbps=None):
+    """`per_fusion_costs` off already-obtained optimized HLO module
+    text (also the unit-testable seam for the parsing/labeling
+    logic)."""
     if peak_flops is None or hbm_gbps is None:
-        kind = getattr(jax.devices()[0], "device_kind", "").lower()
-        table = {"v4": (275e12, 1228.0), "v5 lite": (197e12, 819.0),
-                 "v5e": (197e12, 819.0), "v5p": (459e12, 2765.0),
-                 "v6": (918e12, 1640.0)}
-        pf, bw = 100e12, 800.0
-        for k, (p, b) in table.items():
-            if k in kind:
-                pf, bw = p, b
-                break
+        pf, bw = device_peak_specs()
         peak_flops = peak_flops or pf
         hbm_gbps = hbm_gbps or bw
     comps = _parse_hlo_computations(text)
@@ -363,6 +414,8 @@ def per_fusion_costs(fn, *args, peak_flops=None, hbm_gbps=None, **kwargs):
             elif kind in ("dot", "convolution"):
                 flops = _dot_flops(line) if kind == "dot" else 0
             mop = re.search(r'op_name="([^"]+)"', line)
+            kernel = _custom_call_label(line) if kind == "custom-call" \
+                else None
             calls = mults.get(name, 1)
             est_s = max(flops / peak_flops,
                         nbytes / (hbm_gbps * 1e9),
@@ -372,7 +425,8 @@ def per_fusion_costs(fn, *args, peak_flops=None, hbm_gbps=None, **kwargs):
                         trans / (peak_flops / 16.0)) * calls
             rows.append({
                 "name": iname, "op": mop.group(1) if mop else "",
-                "kind": kind, "flops": int(flops * calls),
+                "kind": kind, "kernel": kernel,
+                "flops": int(flops * calls),
                 "bytes": int(nbytes * calls),
                 "transcendentals": int(trans * calls),
                 "calls": calls, "est_us": est_s * 1e6})
@@ -386,14 +440,20 @@ def per_fusion_costs(fn, *args, peak_flops=None, hbm_gbps=None, **kwargs):
 
 def top_fusion_sinks(fn, *args, top=3, **kwargs):
     """Compact top-N per-fusion sink table (bench extras): list of
-    {op, kind, est_us, time_pct, flops, bytes, calls} rows."""
+    {op, kind, est_us, time_pct, flops, bytes, calls} rows (+ `kernel`
+    for custom-calls — the Pallas kernel label, which also becomes the
+    `op` fallback so a Pallas row is never an opaque "custom-call")."""
     rows = per_fusion_costs(fn, *args, **kwargs)
     out = []
     for r in rows[:top]:
-        out.append({"op": (r["op"] or r["name"])[-120:], "kind": r["kind"],
-                    "est_us": r["est_us"], "time_pct": r["time_pct"],
-                    "flops": r["flops"], "bytes": r["bytes"],
-                    "calls": r["calls"]})
+        row = {"op": (r["op"] or r.get("kernel") or r["name"])[-120:],
+               "kind": r["kind"],
+               "est_us": r["est_us"], "time_pct": r["time_pct"],
+               "flops": r["flops"], "bytes": r["bytes"],
+               "calls": r["calls"]}
+        if r.get("kernel"):
+            row["kernel"] = r["kernel"]
+        out.append(row)
     return out
 
 

@@ -25,6 +25,26 @@ Reference behaviour → JAX mapping:
 
 A `checkpoint_policy` escape hatch (TPU extension) selects any
 `jax.checkpoint_policies` entry by name for selective rematerialisation.
+
+Named custom policies (TPU extension): `register_checkpoint_policy`
+publishes a policy under a string name that `checkpoint()`, the model
+configs' `remat_policy` fields and the `checkpoint_policy` config key
+all resolve (`resolve_checkpoint_policy`).  The built-in
+`"save_fused_epilogues"` policy is the PER-FUSION remat the fused
+epilogue kernels enable (ops/transformer/fused_ops.py): instead of the
+per-layer all-or-nothing (save block inputs, recompute everything), it
+saves exactly the fused kernels' named outputs —
+
+    attn_out / attn_lse        flash attention (never re-run the fwd
+                               kernel; PR 4)
+    fused_ln_out/fused_ln_sum  bias+residual+LayerNorm chain
+    fused_gelu_sum             bias+GeLU input sum (the 4H-wide GeLU
+                               OUTPUT is deliberately recomputed — one
+                               transcendental pass vs 4H bytes/token,
+                               the roofline's bytes/flops verdict)
+
+so the rematted backward recomputes only the cheap glue (a qkv matmul,
+LN stats) instead of the whole block.
 """
 
 import contextlib
@@ -51,6 +71,52 @@ _mesh = None
 _policy_name = None
 _configured = False
 _host_offload_ok = None  # lazily probed
+
+
+# ----------------------------------------------------------------------
+# named checkpoint policies
+# ----------------------------------------------------------------------
+_NAMED_POLICIES = {}
+
+
+def register_checkpoint_policy(name, policy):
+    """Publish a jax.checkpoint policy under a string name, resolvable
+    from every remat_policy/checkpoint_policy config field."""
+    _NAMED_POLICIES[name] = policy
+
+
+def _builtin_policies():
+    if "save_fused_epilogues" not in _NAMED_POLICIES:
+        from deepspeed_tpu.ops.transformer.fused_ops import \
+            FUSED_EPILOGUE_SAVE_NAMES
+        register_checkpoint_policy(
+            "save_fused_epilogues",
+            jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse", *FUSED_EPILOGUE_SAVE_NAMES))
+    return _NAMED_POLICIES
+
+
+def resolve_checkpoint_policy(name):
+    """Policy name -> jax policy: registered custom names first (incl.
+    the built-in "save_fused_epilogues"), then the literal
+    `"save_only_these_names:a,b"` syntax, then `jax.checkpoint_policies`
+    attributes.  None passes through."""
+    if name is None or callable(name):
+        return name
+    policies = _builtin_policies()
+    if name in policies:
+        return policies[name]
+    if name.startswith("save_only_these_names:"):
+        names = [n for n in name.split(":", 1)[1].split(",") if n]
+        return jax.checkpoint_policies.save_only_these_names(*names)
+    try:
+        return getattr(jax.checkpoint_policies, name)
+    except AttributeError:
+        raise ValueError(
+            f"unknown checkpoint policy {name!r}: not a registered "
+            f"custom policy ({sorted(policies)}), a "
+            "save_only_these_names:... spec, or a "
+            "jax.checkpoint_policies attribute") from None
 
 
 def is_configured():
@@ -191,9 +257,7 @@ def checkpoint(function, *args):
     """Checkpoint a function (ref `checkpointing.py:666`): its
     intermediates are recomputed, not saved, in the backward pass.
     Returns `function(*args)`."""
-    policy = None
-    if _policy_name is not None:
-        policy = getattr(jax.checkpoint_policies, _policy_name)
+    policy = resolve_checkpoint_policy(_policy_name)
 
     partition = PARTITION_ACTIVATIONS and _mesh is not None and \
         _model_par(_mesh) > 1

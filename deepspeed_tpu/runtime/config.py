@@ -307,7 +307,18 @@ def get_pld_params(param_dict):
 
 
 def get_pipeline_config(param_dict):
-    return get_scalar_param(param_dict, C.PIPELINE, dict(C.PIPELINE_DEFAULT))
+    pipeline = get_scalar_param(param_dict, C.PIPELINE,
+                                dict(C.PIPELINE_DEFAULT))
+    if not isinstance(pipeline, dict):
+        raise DeepSpeedConfigError(
+            f'"pipeline" must be a dict, got {pipeline!r}')
+    v = pipeline.get(C.PIPELINE_NUM_VIRTUAL_STAGES,
+                     C.PIPELINE_NUM_VIRTUAL_STAGES_DEFAULT)
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        raise DeepSpeedConfigError(
+            f"pipeline.num_virtual_stages must be an int >= 1, got "
+            f"{v!r}")
+    return pipeline
 
 
 def get_mesh_config(param_dict):

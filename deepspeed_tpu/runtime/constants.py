@@ -218,9 +218,20 @@ CHECKPOINT_QUEUE_POLICIES = ["block", "drop"]
 
 #############################################
 # Pipeline block (dict passed through to PipelineEngine)
+#   {"pipeline": {"num_virtual_stages": 2}}
+# num_virtual_stages (TPU-native extension): interleaved 1F1B — each
+#   physical pipe stage hosts v round-robin model chunks
+#   (Megatron-style virtual stages), cutting the fill/drain bubble from
+#   (p-1)/(m+p-1) stage-times toward (p-1)/(v*m+p-1) at the cost of
+#   more in-flight activations and a ~v-times-larger compiled schedule
+#   (compile time grows accordingly — the 1F1B compile warning applies,
+#   amplified). Requires pipe>1, gradient_accumulation_steps divisible
+#   by the stage count, and at least pipe*v layers.
 #############################################
 PIPELINE = "pipeline"
 PIPELINE_DEFAULT = {}
+PIPELINE_NUM_VIRTUAL_STAGES = "num_virtual_stages"
+PIPELINE_NUM_VIRTUAL_STAGES_DEFAULT = 1
 
 #############################################
 # Sparse attention

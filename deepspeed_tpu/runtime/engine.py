@@ -893,6 +893,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             global_steps=jnp.asarray(0, jnp.int32))
 
         n_params = self._count_model_params(params_f32)
+        # cached for the monitor's in-loop MFU derivation (6·N·tokens/s
+        # against the chip's nominal peak — the bench convention)
+        self._n_model_params = n_params
         log_dist(
             f"engine initialized: {n_params/1e6:.1f}M params, "
             f"zero_stage={self.zero_policy.stage}, "
@@ -1405,6 +1408,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.progressive_layer_drop.update_state(self._host_steps)
         batch = self._shard_batch(batch)
         self._tokens_pending += _batch_token_count(batch)
+        # legacy-loop twin of train_batch's accounting: here batch is
+        # ONE microbatch [rows, ...], so tokens/sample = trailing dims
+        # (the deepspeed_io dataloader drives the tput timer on this
+        # path, and the monitor's MFU derivation needs the ratio)
+        lead = np.shape(jax.tree_util.tree_leaves(batch)[0]) \
+            if jax.tree_util.tree_leaves(batch) else ()
+        self._tokens_per_sample = int(np.prod(lead[1:])) \
+            if len(lead) > 1 else 1
         loss, grads = self._micro_grad_jit(
             self.state.params, batch, self._next_rng(),
             self.state.scale.loss_scale, self._keep_prob())
@@ -1658,6 +1669,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self.tput_timer.start()
         batch = self.stage_batch(batch)
         tokens = _batch_token_count(batch)
+        # tokens per SAMPLE (static shape math, no device access): the
+        # stacked batch is [gas, global_rows, ...] and tput counts
+        # samples as rows — the monitor's tokens/s/chip + MFU derive
+        # from this times avg_samples_per_sec
+        lead = np.shape(jax.tree_util.tree_leaves(batch)[0]) \
+            if jax.tree_util.tree_leaves(batch) else ()
+        self._tokens_per_sample = int(np.prod(lead[2:])) \
+            if len(lead) > 2 else 1
         lr = self._host_step_lr()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self._host_steps)

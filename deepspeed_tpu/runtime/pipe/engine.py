@@ -46,6 +46,7 @@ import inspect
 import jax
 import numpy as np
 
+from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS
 from deepspeed_tpu.runtime.pipe.module import PipelineModule
@@ -109,6 +110,13 @@ class PipelineEngine(DeepSpeedEngine):
             f"micro_batches={self.micro_batches}, mode={mode}",
             ranks=[0])
 
+    def _virtual_stages_config(self):
+        """pipeline.num_virtual_stages from the config block (validated
+        int >= 1 by get_pipeline_config)."""
+        return int((self._config.pipeline or {}).get(
+            C.PIPELINE_NUM_VIRTUAL_STAGES,
+            C.PIPELINE_NUM_VIRTUAL_STAGES_DEFAULT))
+
     # ------------------------------------------------------------------
     # model resolution: chain PipelineModule layers into one loss fn
     # ------------------------------------------------------------------
@@ -146,6 +154,21 @@ class PipelineEngine(DeepSpeedEngine):
             self._pipe_flat_mode = (
                 self.mesh.shape[PIPE_AXIS] > 1 and
                 self.gradient_accumulation_steps() > 1)
+            self._pipe_virtual_stages = 1
+            self._chunk_parts = None
+            v_cfg = self._virtual_stages_config()
+            if not self._pipe_flat_mode and v_cfg > 1:
+                # refuse loudly rather than silently train uninterleaved
+                # (the other interleave misconfigurations all raise) —
+                # naming WHICH precondition failed
+                raise ValueError(
+                    f"pipeline.num_virtual_stages={v_cfg} requires the "
+                    "compiled 1F1B executor, which needs a pipe mesh "
+                    f"axis > 1 (got {self.mesh.shape[PIPE_AXIS]}) AND "
+                    "gradient_accumulation_steps > 1 (got "
+                    f"{self.gradient_accumulation_steps()}) — "
+                    "interleaving has nothing to overlap on a "
+                    "sequential layer chain")
             if self._pipe_flat_mode:
                 assert model.num_stages == self.mesh.shape[PIPE_AXIS], (
                     f"PipelineModule was partitioned for "
@@ -155,12 +178,45 @@ class PipelineEngine(DeepSpeedEngine):
                 from jax.sharding import PartitionSpec
                 from deepspeed_tpu.runtime.pipe.flat_params import \
                     StageFlatLayout
+                # interleaved (virtual-stage) 1F1B: pipeline block's
+                # num_virtual_stages splits the model into S*v chunks
+                # assigned round-robin (chunk q on stage q % S), cutting
+                # the fill/drain bubble toward 1/v (pipe/schedule.py
+                # InterleavedTrainSchedule)
+                S = self.mesh.shape[PIPE_AXIS]
+                v = v_cfg
+                stage_layers = None
+                if v > 1:
+                    gas = self.gradient_accumulation_steps()
+                    if gas % S:
+                        raise ValueError(
+                            f"num_virtual_stages={v} requires "
+                            f"gradient_accumulation_steps divisible by "
+                            f"the stage count (microbatch groups of "
+                            f"p): got gas={gas}, pipe={S}")
+                    if len(model.layers) < S * v:
+                        raise ValueError(
+                            f"num_virtual_stages={v} needs at least "
+                            f"stages*virtual = {S * v} layers to form "
+                            f"chunks; the module has "
+                            f"{len(model.layers)}")
+                    self._pipe_virtual_stages = v
+                    self._chunk_parts = model.partition(S * v)
+                    # stage s stores chunks {s, s+S, ...}: the
+                    # round-robin, non-contiguous layer set
+                    stage_layers = [
+                        [idx for j in range(v)
+                         for idx in range(
+                             self._chunk_parts[j * S + s],
+                             self._chunk_parts[j * S + s + 1])]
+                        for s in range(S)]
                 # align so [S, F] divides over model (interp in_specs)
                 # and the composed (model, data) master sharding
                 self._pipe_layout = StageFlatLayout(
                     model, model_parameters,
                     align=self.mesh.shape[MODEL_AXIS] *
-                    self.mesh.shape[DATA_AXIS])
+                    self.mesh.shape[DATA_AXIS],
+                    stage_layers=stage_layers)
                 model_parameters = self._pipe_layout.flatten(
                     model_parameters)
                 self._zero_stage_cap = 2
@@ -209,6 +265,12 @@ class PipelineEngine(DeepSpeedEngine):
             return
 
         if self._pipelined_protocol:
+            if self._virtual_stages_config() > 1:
+                raise ValueError(
+                    "pipeline.num_virtual_stages applies to the "
+                    "compiled 1F1B executor (PipelineModule); the "
+                    "stacked-stage SPMD protocol (PipelinedGPT2) has "
+                    "no virtual-stage schedule")
             # PipelinedGPT2-style protocol: bind the mesh into the loss
             # so activation buffers carry pipe shardings (the mesh is
             # built before model resolution in the base __init__).
@@ -293,9 +355,13 @@ class PipelineEngine(DeepSpeedEngine):
         self._interp_sig = self._batch_sig(stacked_batch)
         # a multi-minute 1F1B compile is indistinguishable from a hang
         # without this: the stall diagnostic shows a fresh "compile"
-        # heartbeat instead of a dead engine
+        # heartbeat instead of a dead engine (interleaving multiplies
+        # the schedule ticks by ~v and the lax.switch branch count by
+        # v, so its compile is correspondingly longer — the same
+        # warning applies, amplified)
         self.monitor.heartbeat("compile")
         from deepspeed_tpu.runtime.pipe.interp import build_pipeline_step
+        v = getattr(self, "_pipe_virtual_stages", 1)
         self._interp_fn = build_pipeline_step(
             module=self.module, mesh=self.mesh,
             micro_batches=self.micro_batches,
@@ -303,11 +369,17 @@ class PipelineEngine(DeepSpeedEngine):
             batch_example=self._interp_example_mb(stacked_batch),
             split_batch=_split_batch,
             det_accepting=_layers_accepting_deterministic(self.module),
-            layout=getattr(self, "_pipe_layout", None))
+            layout=getattr(self, "_pipe_layout", None),
+            num_virtual_stages=v,
+            chunk_parts=getattr(self, "_chunk_parts", None))
         log_dist(
-            f"PipelineEngine: compiled 1F1B schedule over "
-            f"{self.num_stages} stages, {self.micro_batches} "
-            "microbatches (clock-aligned TrainSchedule)", ranks=[0])
+            f"PipelineEngine: compiled "
+            f"{'interleaved ' if v > 1 else ''}1F1B schedule over "
+            f"{self.num_stages} stages"
+            + (f" x {v} virtual" if v > 1 else "")
+            + f", {self.micro_batches} microbatches (clock-aligned "
+            f"{'InterleavedTrainSchedule' if v > 1 else 'TrainSchedule'}"
+            ")", ranks=[0])
 
     def _ensure_eval_interp(self, stacked_batch):
         """Forward-only pipelined eval (the InferenceSchedule dataflow,
@@ -335,7 +407,9 @@ class PipelineEngine(DeepSpeedEngine):
             batch_example=self._interp_example_mb(stacked_batch),
             split_batch=_split_batch,
             det_accepting=_layers_accepting_deterministic(self.module),
-            train=False, layout=getattr(self, "_pipe_layout", None))
+            train=False, layout=getattr(self, "_pipe_layout", None),
+            num_virtual_stages=getattr(self, "_pipe_virtual_stages", 1),
+            chunk_parts=getattr(self, "_chunk_parts", None))
         self._eval_interp_jit = cache[sig] = jax.jit(eval_fn)
 
     # ------------------------------------------------------------------

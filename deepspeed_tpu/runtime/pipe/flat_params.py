@@ -43,19 +43,29 @@ class StageFlatLayout:
     work identically on host numpy and inside jit.
     """
 
-    def __init__(self, module, params_example, align=1):
+    def __init__(self, module, params_example, align=1,
+                 stage_layers=None):
         """align: round the per-dtype buffer width F up to a multiple —
         the engine passes model*data so the [S, F] buffers divide evenly
         over the model axis (interp in_specs) and the composed
-        (model, data) master sharding (zero/partition.py)."""
-        self.S = module.num_stages
-        parts = module.parts
+        (model, data) master sharding (zero/partition.py).
+
+        stage_layers: optional explicit per-stage layer-index lists
+        (len = physical stage count).  Interleaved 1F1B passes the
+        round-robin chunk assignment here — stage s stores chunks
+        {s, s+S, ...}, a NON-contiguous layer set the default
+        module.parts ranges cannot express."""
+        if stage_layers is None:
+            parts = module.parts
+            stage_layers = [list(range(parts[s], parts[s + 1]))
+                            for s in range(module.num_stages)]
+        self.S = len(stage_layers)
         self._stage_treedefs = []
         self._stage_meta = []      # per stage: list of (dt_key, offset, shape)
         sizes = {}                 # dt_key -> per-stage sizes
         for s in range(self.S):
             sub = {str(i): params_example["layers"][str(i)]
-                   for i in range(parts[s], parts[s + 1])
+                   for i in stage_layers[s]
                    if str(i) in params_example.get("layers", {})}
             leaves, treedef = jax.tree_util.tree_flatten(sub)
             self._stage_treedefs.append(treedef)

@@ -62,81 +62,133 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
                                         stacked_batch_pspecs)
 from deepspeed_tpu.runtime.pipe.schedule import (
-    TrainSchedule, ForwardPass, BackwardPass, SendActivation,
-    RecvActivation, SendGrad, RecvGrad, LoadMicroBatch)
+    TrainSchedule, InterleavedTrainSchedule, ForwardPass, BackwardPass,
+    SendActivation, RecvActivation, SendGrad, RecvGrad, LoadMicroBatch,
+    interleaved_fwd_cmds)
 
 
 # ----------------------------------------------------------------------
 # schedule -> clock tables
 # ----------------------------------------------------------------------
-def _inference_streams(m, S):
+def _inference_streams(m, S, v=1):
     """Canonical fwd-only streams with InferenceSchedule's dataflow
     (`schedule.py:86-127`). The literal InferenceSchedule emits
     SendActivation one step AFTER the producing ForwardPass (a
     host-runtime buffering detail); the compiled executor's send
     register holds exactly one tick, so the send is folded into the
-    producing step — same dependency structure, same 2-buffer bound."""
+    producing step — same dependency structure, same 2-buffer bound.
+
+    v > 1: the interleaved forward order (microbatch groups of S,
+    chunks round-robin — InterleavedTrainSchedule's fwd stream) with
+    two alternating buffers per chunk."""
+    n_chunks = S * v
     streams = []
     for s in range(S):
         steps = []
-        for mb in range(m):
-            cmds = []
-            if s > 0:
-                cmds.append(RecvActivation(mb % 2))
-            if s == 0 or s == S - 1:
-                cmds.append(LoadMicroBatch(mb % 2))
-            cmds.append(ForwardPass(mb % 2))
-            if s < S - 1:
-                cmds.append(SendActivation(mb % 2))
-            steps.append(cmds)
+        if v == 1:
+            order = [(0, mb) for mb in range(m)]
+        else:
+            sched = InterleavedTrainSchedule(m, S, s, v)
+            order = [sched._fwd_cm(i) for i in range(m * v)]
+        for vidx, mb in order:
+            # two alternating eval buffers per chunk; the dataflow
+            # itself comes from the schedule's single source of truth
+            steps.append(interleaved_fwd_cmds(
+                s, S, n_chunks, vidx, mb, vidx * 2 + mb % 2))
         streams.append(steps)
     return streams
 
 
-def build_clock_tables(micro_batches, stages, train=True):
+def build_clock_tables(micro_batches, stages, train=True,
+                       num_virtual_stages=1):
     """Align the per-stage schedule streams on a global clock
-    (TrainSchedule, or the fwd-only InferenceSchedule dataflow when
-    train=False).
+    (TrainSchedule / InterleavedTrainSchedule for num_virtual_stages>1,
+    or the fwd-only InferenceSchedule dataflow when train=False).
 
     Each stage executes at most one schedule step per tick; a step is
     eligible when every RecvActivation/RecvGrad it contains pairs with
     a Send* completed at an EARLIER tick (k-th recv on a channel pairs
     with the k-th send — FIFO), and any Send* it contains has a free
-    channel slot. Returns int/bool arrays indexed [tick, stage]."""
-    m, S = micro_batches, stages
-    if train:
-        streams = [list(TrainSchedule(m, S, s).steps()) for s in range(S)]
-    else:
-        streams = _inference_streams(m, S)
+    channel slot. Returns int/bool arrays indexed [tick, stage].
 
+    Channels form a RING when interleaving (round-robin chunk q sends
+    forward to stage (q+1) mod S — the last stage's non-final chunks
+    wrap to stage 0); with one virtual stage the wrap channels are
+    never used and the tables are bit-identical to before.  The
+    fwd/bwd chunk rows carry the GLOBAL chunk id (vidx·S + s) the
+    executor's lax.switch dispatches on, and the sent_act/sent_grad
+    rows gate the executor's send registers so an op that does not
+    send (e.g. the loss chunk on the last stage) cannot clobber an
+    undelivered value."""
+    m, S, v = micro_batches, stages, int(num_virtual_stages)
+    if train:
+        if v > 1:
+            streams = [list(InterleavedTrainSchedule(m, S, s, v).steps())
+                       for s in range(S)]
+        else:
+            streams = [list(TrainSchedule(m, S, s).steps())
+                       for s in range(S)]
+    else:
+        streams = _inference_streams(m, S, v)
+
+    # one-slot channels deadlock the interleaved ring (every stage's
+    # warmup wants recv+fwd+send atomically while every channel holds
+    # an undelivered value); depth-2 rings break the cycle for every
+    # (m, S, v) swept — retry upward as a safety margin. v == 1 keeps
+    # the single slot: tables (and the compiled program) stay identical
+    # to the pre-interleaving executor.
+    caps = (1,) if v == 1 else (2, 3, 4, 2 * v * S)
+    tables = None
+    for cap in caps:
+        tables = _align_streams(streams, S, cap,
+                                max_ticks=4 * (m * v + S) + 16)
+        if tables is not None:
+            break
+    assert tables is not None, "clock alignment did not converge"
+    return tables
+
+
+def _align_streams(streams, S, cap, max_ticks):
+    """Greedy clock alignment of per-stage instruction streams with
+    `cap`-deep FIFO delivery rings per channel.  Returns the tick
+    tables, or None if the streams deadlock at this capacity."""
     fwd_mb = []
     fwd_buf = []
+    fwd_ch = []
     bwd_mb = []
     bwd_buf = []
+    bwd_ch = []
     sent_act = []
     sent_grad = []
+    recv_act_slot = []
+    recv_grad_slot = []
 
-    send_act_ticks = [[] for _ in range(S)]
+    send_act_count = [0] * S
     recv_act_count = [0] * S
-    send_grad_ticks = [[] for _ in range(S)]
+    send_grad_count = [0] * S
     recv_grad_count = [0] * S
     fwd_count = [0] * S
     bwd_count = [0] * S
     ptr = [0] * S
     t = 0
-    max_ticks = 4 * (m + S) + 8
     while any(ptr[s] < len(streams[s]) for s in range(S)):
-        assert t < max_ticks, "clock alignment did not converge"
+        if t >= max_ticks:
+            return None
         f_row = [-1] * S
         fb_row = [0] * S
+        fc_row = [0] * S
         b_row = [-1] * S
         bb_row = [0] * S
+        bc_row = [0] * S
         sa_row = [False] * S
         sg_row = [False] * S
-        snap_sa = [len(x) for x in send_act_ticks]
-        snap_sg = [len(x) for x in send_grad_ticks]
+        ras_row = [-1] * S
+        rgs_row = [-1] * S
+        snap_sa = list(send_act_count)
+        snap_sg = list(send_grad_count)
         snap_ra = list(recv_act_count)
         snap_rg = list(recv_grad_count)
+        progressed = False
         for s in range(S):
             if ptr[s] >= len(streams[s]):
                 continue
@@ -144,68 +196,113 @@ def build_clock_tables(micro_batches, stages, train=True):
             ok = True
             for c in cmds:
                 if isinstance(c, RecvActivation):
-                    k = recv_act_count[s]
-                    ok &= k < snap_sa[s - 1]
+                    # k-th recv pairs with the k-th send (FIFO), which
+                    # must have completed at an EARLIER tick
+                    ok &= recv_act_count[s] < snap_sa[(s - 1) % S]
                 elif isinstance(c, RecvGrad):
-                    k = recv_grad_count[s]
-                    ok &= k < snap_sg[s + 1]
+                    ok &= recv_grad_count[s] < snap_sg[(s + 1) % S]
                 elif isinstance(c, SendActivation):
-                    # one-slot channel: previous send must be consumed
-                    ok &= len(send_act_ticks[s]) <= snap_ra[s + 1]
+                    # ring depth: at most `cap` sends in flight
+                    # (delivered-but-unconsumed) per channel
+                    ok &= send_act_count[s] - snap_ra[(s + 1) % S] < cap
                 elif isinstance(c, SendGrad):
-                    ok &= len(send_grad_ticks[s]) <= snap_rg[s - 1]
+                    ok &= send_grad_count[s] - snap_rg[(s - 1) % S] < cap
             if not ok:
                 continue
+            progressed = True
             for c in cmds:
                 if isinstance(c, RecvActivation):
+                    ras_row[s] = recv_act_count[s] % cap
                     recv_act_count[s] += 1
                 elif isinstance(c, RecvGrad):
+                    rgs_row[s] = recv_grad_count[s] % cap
                     recv_grad_count[s] += 1
                 elif isinstance(c, SendActivation):
-                    send_act_ticks[s].append(t)
+                    send_act_count[s] += 1
                     sa_row[s] = True
                 elif isinstance(c, SendGrad):
-                    send_grad_ticks[s].append(t)
+                    send_grad_count[s] += 1
                     sg_row[s] = True
                 elif isinstance(c, ForwardPass):
-                    f_row[s] = fwd_count[s]
+                    # the executor needs the MICROBATCH id (what the
+                    # first/last chunks index the stacked batch with);
+                    # plain schedules execute microbatches in order so
+                    # the fwd ordinal doubles as the id, interleaved
+                    # ops carry it explicitly
+                    f_row[s] = getattr(c, "mb", fwd_count[s])
                     fb_row[s] = c.buffer_id
+                    fc_row[s] = getattr(c, "chunk", 0) * S + s
                     fwd_count[s] += 1
                 elif isinstance(c, BackwardPass):
-                    b_row[s] = bwd_count[s]
+                    b_row[s] = getattr(c, "mb", bwd_count[s])
                     bb_row[s] = c.buffer_id
+                    bc_row[s] = getattr(c, "chunk", 0) * S + s
                     bwd_count[s] += 1
             ptr[s] += 1
         fwd_mb.append(f_row)
         fwd_buf.append(fb_row)
+        fwd_ch.append(fc_row)
         bwd_mb.append(b_row)
         bwd_buf.append(bb_row)
+        bwd_ch.append(bc_row)
         sent_act.append(sa_row)
         sent_grad.append(sg_row)
+        recv_act_slot.append(ras_row)
+        recv_grad_slot.append(rgs_row)
         t += 1
+        if not progressed:
+            return None
 
     T = t
     sent_act = np.asarray(sent_act, bool)
     sent_grad = np.asarray(sent_grad, bool)
-    # delivery at tick t = what the neighbor sent at tick t-1
+    # delivery at tick t = what the ring neighbor sent at tick t-1
+    # (acts travel +1 mod S, grads -1 mod S; the wrap columns are
+    # all-False when v == 1).  The k-th delivery lands in ring slot
+    # k % cap — the slot the k-th recv reads.
     deliver_act = np.zeros((T, S), bool)
-    deliver_act[1:, 1:] = sent_act[:-1, :-1]
+    deliver_act[1:] = np.roll(sent_act[:-1], 1, axis=1)
     deliver_grad = np.zeros((T, S), bool)
-    deliver_grad[1:, :-1] = sent_grad[:-1, 1:]
+    deliver_grad[1:] = np.roll(sent_grad[:-1], -1, axis=1)
+    deliver_act_slot = np.full((T, S), -1, np.int32)
+    deliver_grad_slot = np.full((T, S), -1, np.int32)
+    dcount_a = np.zeros(S, np.int64)
+    dcount_g = np.zeros(S, np.int64)
+    for tick in range(T):
+        for s in range(S):
+            if deliver_act[tick, s]:
+                deliver_act_slot[tick, s] = dcount_a[s] % cap
+                dcount_a[s] += 1
+            if deliver_grad[tick, s]:
+                deliver_grad_slot[tick, s] = dcount_g[s] % cap
+                dcount_g[s] += 1
     return {
         "fwd_mb": np.asarray(fwd_mb, np.int32),
         "fwd_buf": np.asarray(fwd_buf, np.int32),
+        "fwd_chunk": np.asarray(fwd_ch, np.int32),
         "bwd_mb": np.asarray(bwd_mb, np.int32),
         "bwd_buf": np.asarray(bwd_buf, np.int32),
+        "bwd_chunk": np.asarray(bwd_ch, np.int32),
+        "sent_act": sent_act,
+        "sent_grad": sent_grad,
         "deliver_act": deliver_act,
         "deliver_grad": deliver_grad,
+        "deliver_act_slot": deliver_act_slot,
+        "deliver_grad_slot": deliver_grad_slot,
+        "recv_act_slot": np.asarray(recv_act_slot, np.int32),
+        "recv_grad_slot": np.asarray(recv_grad_slot, np.int32),
+        "channel_depth": cap,
         "num_ticks": T,
     }
 
 
-def num_pipe_buffers(micro_batches, stages):
+def num_pipe_buffers(micro_batches, stages, num_virtual_stages=1):
     """Global buffer-array bound: the worst stage's
-    TrainSchedule.num_pipe_buffers() (stage 0: min(stages+1, m))."""
+    num_pipe_buffers() (plain 1F1B stage 0: min(stages+1, m))."""
+    if num_virtual_stages > 1:
+        return max(InterleavedTrainSchedule(
+            micro_batches, stages, s, num_virtual_stages)
+            .num_pipe_buffers() for s in range(stages))
     return max(TrainSchedule(micro_batches, stages, s).num_pipe_buffers()
                for s in range(stages))
 
@@ -221,7 +318,8 @@ def _microbatch(tree, mb):
 
 def build_pipeline_step(module, mesh, micro_batches, params_example,
                         batch_example, split_batch, det_accepting,
-                        train=True, layout=None):
+                        train=True, layout=None, num_virtual_stages=1,
+                        chunk_parts=None):
     """Compile-time construction of the pipelined step function:
     `(params, stacked_batch, rng, loss_scale) -> (loss, grads)` for
     train=True (1F1B), or `... -> loss` for train=False (the fwd-only
@@ -237,18 +335,36 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
     local [1, F] view (the SPMD form of the reference building only
     local layers per process, ref module.py:197-249), and gradients
     come back in the same layout (flat [S, F] per dtype + replicated
-    tied tree). Without it, params are a replicated full tree."""
+    tied tree). Without it, params are a replicated full tree.
+
+    num_virtual_stages > 1 compiles the INTERLEAVED 1F1B schedule
+    (InterleavedTrainSchedule): the model is split into S·v chunks
+    (`chunk_parts`, a parts list of length S·v+1) assigned round-robin
+    (chunk q on stage q mod S), every tick's lax.switch dispatches on
+    the GLOBAL chunk id, activations/cotangents ride a closed ppermute
+    ring with depth-`channel_depth` FIFO delivery slots, and the
+    fill/drain bubble shrinks from (S-1)/(m+S-1) stage-times toward
+    (S-1)/(v·m+S-1).  v == 1 compiles the exact pre-interleaving
+    program (chain permutes, single delivery slot)."""
     S = mesh.shape[PIPE_AXIS]
     M = mesh.shape[MODEL_AXIS]
     m = micro_batches
-    tables = build_clock_tables(m, S, train=train)
-    B = num_pipe_buffers(m, S) if train else 1
-    parts = module.parts
+    v = int(num_virtual_stages)
+    n_chunks = S * v
+    tables = dict(build_clock_tables(m, S, train=train,
+                                     num_virtual_stages=v))
+    C = int(tables.pop("channel_depth"))
+    B = num_pipe_buffers(m, S, v) if train else 2 * v
+    parts = list(module.parts) if chunk_parts is None else \
+        list(chunk_parts)
+    assert len(parts) == n_chunks + 1, (
+        f"chunk parts length {len(parts)} != stages*virtual+1 = "
+        f"{n_chunks + 1}")
 
     inputs_ex, labels_ex = split_batch(batch_example)
 
-    def run_stage(s, params, x, rng, deterministic):
-        start, stop = parts[s], parts[s + 1]
+    def run_chunk(q, params, x, rng, deterministic):
+        start, stop = parts[q], parts[q + 1]
         for idx in range(start, stop):
             kw = {}
             if idx in det_accepting:
@@ -261,7 +377,10 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
     # -- param carrier: what the backward differentiates against ------
     # legacy: the (replicated) full tree itself.  flat layout: the
     # shard-local flat buffers + the tied tree; `params_of` rebuilds a
-    # stage-sufficient {"layers", "tied"} dict from either.
+    # stage-sufficient {"layers", "tied"} dict from either.  A chunk's
+    # layers live in its OWNER stage's segment (round-robin: stage
+    # q mod S), which is exactly the local shard wherever the chunk's
+    # switch branch actually executes.
     if layout is None:
         def carrier_of(params):
             return params
@@ -312,18 +431,18 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                      for dt in layout.F}
             return dflat, dtied
 
-    # boundary avals: activation entering stage s (s >= 1); shape
+    # boundary avals: activation entering chunk q (q >= 1); shape
     # inference runs on the logical full tree regardless of storage
     full_example = params_example if layout is None else \
         jax.eval_shape(layout.unflatten, params_example)
     bnd = []
     x_aval = jax.eval_shape(lambda x: x, inputs_ex)
-    for s in range(S):
+    for q in range(n_chunks):
         x_aval = jax.eval_shape(
-            functools.partial(run_stage, s, deterministic=True, rng=None),
+            functools.partial(run_chunk, q, deterministic=True, rng=None),
             full_example, x_aval)
         bnd.append(x_aval)
-    # bnd[s] = output of stage s = input of stage s+1
+    # bnd[q] = output of chunk q = input of chunk q+1
     in_avals = [jax.eval_shape(lambda x: x, inputs_ex)] + bnd[:-1]
     flat_sizes = [
         sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(a))
@@ -355,19 +474,19 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             off += n
         return jax.tree_util.tree_unflatten(treedef, out)
 
-    def stage_input(s, flat, batch, mb):
-        if s == 0:
+    def chunk_input(q, flat, batch, mb):
+        if q == 0:
             inputs, _ = split_batch(batch)
             return _microbatch(inputs, mb)
-        return from_flat(flat, in_avals[s])
+        return from_flat(flat, in_avals[q])
 
-    def fwd_fn(s):
-        def fn(params, act_hold, batch, mb, rng, loss_scale):
-            x = stage_input(s, act_hold, batch, mb)
-            r = jax.random.fold_in(jax.random.fold_in(rng, mb), s)
-            y = run_stage(s, params_of(s, carrier_of(params)), x, r,
+    def fwd_fn(q):
+        def fn(params, act_in, batch, mb, rng, loss_scale):
+            x = chunk_input(q, act_in, batch, mb)
+            r = jax.random.fold_in(jax.random.fold_in(rng, mb), q)
+            y = run_chunk(q, params_of(q % S, carrier_of(params)), x, r,
                           deterministic=not train)
-            if s == S - 1:
+            if q == n_chunks - 1:
                 _, labels = split_batch(batch)
                 loss = module.loss_fn(y, _microbatch(labels, mb)) \
                     if module.loss_fn is not None else y
@@ -380,16 +499,16 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
         return jax.tree_util.tree_map(
             lambda g_: g_.astype(jnp.float32), dcarrier)
 
-    def bwd_fn(s):
-        def fn(params, x_saved_flat, grad_hold, batch, mb, rng,
+    def bwd_fn(q):
+        def fn(params, x_saved_flat, grad_in, batch, mb, rng,
                loss_scale):
-            x = stage_input(s, x_saved_flat, batch, mb)
-            r = jax.random.fold_in(jax.random.fold_in(rng, mb), s)
+            x = chunk_input(q, x_saved_flat, batch, mb)
+            r = jax.random.fold_in(jax.random.fold_in(rng, mb), q)
             carrier = carrier_of(params)
 
-            if s == S - 1:
+            if q == n_chunks - 1:
                 def g(c, xx):
-                    y = run_stage(s, params_of(s, c), xx, r,
+                    y = run_chunk(q, params_of(q % S, c), xx, r,
                                   deterministic=False)
                     _, labels = split_batch(batch)
                     loss = module.loss_fn(y, _microbatch(labels, mb)) \
@@ -398,11 +517,11 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
                 cot = loss_scale / m
             else:
                 def g(c, xx):
-                    return run_stage(s, params_of(s, c), xx, r,
+                    return run_chunk(q, params_of(q % S, c), xx, r,
                                      deterministic=False)
-                cot = from_flat(grad_hold, bnd[s])
+                cot = from_flat(grad_in, bnd[q])
 
-            if s == 0:
+            if q == 0:
                 _, vjp = jax.vjp(lambda c: g(c, x), carrier)
                 (dcarrier,) = vjp(cot)
                 dx_flat = jnp.zeros((A,), tdt)
@@ -413,19 +532,34 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             return dx_flat, _grads_f32(dcarrier)
         return fn
 
-    fwd_fns = [fwd_fn(s) for s in range(S)]
-    bwd_fns = [bwd_fn(s) for s in range(S)] if train else []
+    fwd_fns = [fwd_fn(q) for q in range(n_chunks)]
+    bwd_fns = [bwd_fn(q) for q in range(n_chunks)] if train else []
 
-    fwd_perm = [(i, i + 1) for i in range(S - 1)]
-    bwd_perm = [(i + 1, i) for i in range(S - 1)]
+    # acts travel +1, cotangents -1; interleaving closes the ring (the
+    # last stage's non-final chunks feed stage 0)
+    if v > 1:
+        fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+        bwd_perm = [((i + 1) % S, i) for i in range(S)]
+    else:
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
-    rows = {k: jnp.asarray(v) for k, v in tables.items()
+    rows = {k: jnp.asarray(val) for k, val in tables.items()
             if k != "num_ticks"}
+
+    def _ring_write(ring, value, slot):
+        upd = jax.lax.dynamic_update_index_in_dim(
+            ring, value, jnp.maximum(slot, 0), 0)
+        return jnp.where(slot >= 0, upd, ring)
+
+    def _ring_read(ring, slot):
+        return jax.lax.dynamic_index_in_dim(
+            ring, jnp.maximum(slot, 0), 0, keepdims=False)
 
     def local_step(params, stacked_batch, rng, loss_scale):
         s = jax.lax.axis_index(PIPE_AXIS)
         dp = mesh.shape[DATA_AXIS]
-        # decorrelate dropout across data shards (stage folding happens
+        # decorrelate dropout across data shards (chunk folding happens
         # per-branch in fwd_fn/bwd_fn; fwd and recompute share the key)
         rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
 
@@ -433,15 +567,17 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             # minimal carry: no grads tree, no backward registers or
             # saved-input buffers, no backward ppermute per tick
             def tick_eval(carry, row):
-                act_hold, fwd_out, loss_sum = carry
+                act_ring, fwd_out, loss_sum = carry
                 perm_act = jax.lax.ppermute(fwd_out, PIPE_AXIS, fwd_perm)
-                act_hold = jnp.where(row["deliver_act"][s], perm_act,
-                                     act_hold)
+                act_ring = _ring_write(act_ring, perm_act,
+                                       row["deliver_act_slot"][s])
                 my_fwd = row["fwd_mb"][s]
+                my_chunk = row["fwd_chunk"][s]
+                x_in = _ring_read(act_ring, row["recv_act_slot"][s])
 
                 def do_fwd(_):
                     return jax.lax.switch(
-                        s, fwd_fns, params, act_hold, stacked_batch,
+                        my_chunk, fwd_fns, params, x_in, stacked_batch,
                         my_fwd, rng, loss_scale)
 
                 def no_fwd(_):
@@ -449,11 +585,16 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
                 new_fwd_out, loss_inc = jax.lax.cond(
                     my_fwd >= 0, do_fwd, no_fwd, None)
-                return (act_hold, new_fwd_out, loss_sum + loss_inc), None
+                # only a sending op may occupy the send register (the
+                # loss chunk's output must not clobber an undelivered
+                # value riding the same register)
+                fwd_next = jnp.where(row["sent_act"][s], new_fwd_out,
+                                     fwd_out)
+                return (act_ring, fwd_next, loss_sum + loss_inc), None
 
             carry, _ = jax.lax.scan(
                 tick_eval,
-                (jnp.zeros((A,), tdt),
+                (jnp.zeros((C, A), tdt),
                  jnp.zeros((A,), tdt), jnp.float32(0.0)),
                 rows)
             loss = jax.lax.psum(carry[2], PIPE_AXIS) / m
@@ -470,24 +611,28 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             local_grads(carrier_of(params)))
 
         def tick(carry, row):
-            (act_hold, grad_hold, fwd_out, grad_out, bufs, loss_sum,
+            (act_ring, grad_ring, fwd_out, grad_out, bufs, loss_sum,
              grads_acc) = carry
-            # communication phase: deliver last tick's sends
+            # communication phase: deliver last tick's sends into their
+            # FIFO ring slots
             perm_act = jax.lax.ppermute(fwd_out, PIPE_AXIS, fwd_perm)
             perm_grad = jax.lax.ppermute(grad_out, PIPE_AXIS, bwd_perm)
-            act_hold = jnp.where(row["deliver_act"][s], perm_act,
-                                 act_hold)
-            grad_hold = jnp.where(row["deliver_grad"][s], perm_grad,
-                                  grad_hold)
+            act_ring = _ring_write(act_ring, perm_act,
+                                   row["deliver_act_slot"][s])
+            grad_ring = _ring_write(grad_ring, perm_grad,
+                                    row["deliver_grad_slot"][s])
 
             my_fwd = row["fwd_mb"][s]
             my_fbuf = row["fwd_buf"][s]
+            my_fchunk = row["fwd_chunk"][s]
             my_bwd = row["bwd_mb"][s]
             my_bbuf = row["bwd_buf"][s]
+            my_bchunk = row["bwd_chunk"][s]
+            x_in = _ring_read(act_ring, row["recv_act_slot"][s])
 
             def do_fwd(_):
                 out, loss = jax.lax.switch(
-                    s, fwd_fns, params, act_hold, stacked_batch,
+                    my_fchunk, fwd_fns, params, x_in, stacked_batch,
                     my_fwd, rng, loss_scale)
                 return out, loss
 
@@ -497,18 +642,24 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             new_fwd_out, loss_inc = jax.lax.cond(my_fwd >= 0, do_fwd,
                                                  no_fwd, None)
             loss_sum = loss_sum + loss_inc
-            # save the stage-INPUT activation for backward recompute
+            # only a sending op occupies the send register — an op with
+            # no SendActivation (the loss chunk) must not clobber a
+            # value still riding toward its delivery
+            fwd_next = jnp.where(row["sent_act"][s], new_fwd_out,
+                                 fwd_out)
+            # save the chunk-INPUT activation for backward recompute
             bufs = jnp.where(
                 my_fwd >= 0,
                 jax.lax.dynamic_update_index_in_dim(
-                    bufs, act_hold, my_fbuf, 0),
+                    bufs, x_in, my_fbuf, 0),
                 bufs)
 
             def do_bwd(_):
                 x_saved = jax.lax.dynamic_index_in_dim(
                     bufs, my_bbuf, 0, keepdims=False)
+                g_in = _ring_read(grad_ring, row["recv_grad_slot"][s])
                 dx, dparams = jax.lax.switch(
-                    s, bwd_fns, params, x_saved, grad_hold,
+                    my_bchunk, bwd_fns, params, x_saved, g_in,
                     stacked_batch, my_bwd, rng, loss_scale)
                 return dx, local_grads(dparams)
 
@@ -517,16 +668,18 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
 
             new_grad_out, dparams = jax.lax.cond(my_bwd >= 0, do_bwd,
                                                  no_bwd, None)
+            grad_next = jnp.where(row["sent_grad"][s], new_grad_out,
+                                  grad_out)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc,
                                                dparams)
-            return (act_hold, grad_hold, new_fwd_out, new_grad_out,
+            return (act_ring, grad_ring, fwd_next, grad_next,
                     bufs, loss_sum, grads_acc), None
 
-        init = (jnp.zeros((A,), tdt),    # act_hold
-                jnp.zeros((A,), tdt),    # grad_hold
-                jnp.zeros((A,), tdt),    # fwd_out
-                jnp.zeros((A,), tdt),    # grad_out
-                jnp.zeros((B, A), tdt),  # saved stage inputs
+        init = (jnp.zeros((C, A), tdt),  # act delivery ring
+                jnp.zeros((C, A), tdt),  # grad delivery ring
+                jnp.zeros((A,), tdt),    # fwd_out (send register)
+                jnp.zeros((A,), tdt),    # grad_out (send register)
+                jnp.zeros((B, A), tdt),  # saved chunk inputs
                 jnp.float32(0.0), zeros_grads)
         carry, _ = jax.lax.scan(tick, init, rows)
         loss_sum = carry[5]

@@ -144,16 +144,21 @@ class PipelineModule:
             counts.append(max(n, 1))
         return counts
 
-    def _partition_layers(self):
+    def partition(self, num_parts):
+        """Partition the layer list into `num_parts` contiguous parts
+        with the module's partition_method; returns the parts offsets
+        (length num_parts+1).  The engine uses this with
+        num_parts = stages * num_virtual_stages to build the
+        round-robin chunk assignment of interleaved 1F1B."""
         method_orig = self.partition_method or "parameters"
         method = method_orig.lower()
         num_layers = len(self._layer_specs)
         if method == "uniform":
-            parts = partition_uniform(num_layers, self.num_stages)
-        elif method in ("parameters", "params"):
+            return partition_uniform(num_layers, num_parts)
+        if method in ("parameters", "params"):
             weights = self._count_layer_params()
-            parts = partition_balanced(weights, self.num_stages)
-        elif method.startswith("type:"):
+            return partition_balanced(weights, num_parts)
+        if method.startswith("type:"):
             # keep original case: the regex matches class names
             layertype = method_orig.split(":", 1)[1]
             binary_weights = [0] * num_layers
@@ -162,13 +167,15 @@ class PipelineModule:
                     layer, type) else layer.__name__
                 if regex_matches(layertype, name):
                     binary_weights[idx] = 1
-            parts = partition_balanced(binary_weights, self.num_stages)
-        elif method == "profile":
+            return partition_balanced(binary_weights, num_parts)
+        if method == "profile":
             raise NotImplementedError(
                 "profile-based partitioning not implemented")
-        else:
-            raise NotImplementedError(
-                f"Partitioning method {method} not implemented")
+        raise NotImplementedError(
+            f"Partitioning method {method} not implemented")
+
+    def _partition_layers(self):
+        parts = self.partition(self.num_stages)
         for stage in range(self.num_stages):
             start, stop = parts[stage], parts[stage + 1]
             logger.info(f"pipeline stage={stage} layers={stop - start} "

@@ -181,6 +181,145 @@ class TrainSchedule(PipeSchedule):
         return max(2, buffers)
 
 
+def interleaved_fwd_cmds(stage, stages, num_chunks, vidx, mb, buf):
+    """Forward command emission for one interleaved (chunk, microbatch)
+    op — the ONE source of truth for the dataflow (recv when not the
+    first chunk, load data/labels on the first/last chunk, send when
+    not the last), shared by InterleavedTrainSchedule.steps() and the
+    fwd-only eval streams (interp._inference_streams)."""
+    q = vidx * stages + stage
+    cmds = []
+    if q > 0:
+        cmds.append(RecvActivation(buf, chunk=vidx))
+    if q == 0 or q == num_chunks - 1:
+        cmds.append(LoadMicroBatch(buf, chunk=vidx, mb=mb))
+    cmds.append(ForwardPass(buf, chunk=vidx, mb=mb))
+    if q < num_chunks - 1:
+        cmds.append(SendActivation(buf, chunk=vidx))
+    return cmds
+
+
+class InterleavedTrainSchedule(PipeSchedule):
+    """Interleaved (virtual-stage) 1F1B — the Megatron-LM schedule that
+    cuts the pipeline bubble from (p-1)/(m+p-1) stage-times toward
+    (p-1)/(v·m+p-1): every physical stage hosts `v` model chunks
+    assigned ROUND-ROBIN (global chunk q lives on stage q % p as its
+    q // p-th virtual stage), so the fill/drain ramp advances in
+    chunk-times (1/v of a stage-time) instead of stage-times.
+
+    Microbatches are processed in groups of p: the i-th forward op of a
+    stage runs chunk (i % (p·v)) // p on microbatch
+    (i // (p·v))·p + i % p; backwards mirror the order with chunks
+    reversed.  Warmup depth is the Megatron formula
+    2·(p - stage - 1) + (v - 1)·p, then strict 1F1B alternation, then
+    the backward drain.  Requires micro_batches % stages == 0 (the
+    group structure).
+
+    Instruction streams carry a `chunk` kwarg (the LOCAL virtual index)
+    on Forward/BackwardPass; communication is a RING — the last stage's
+    non-final chunks send activations to stage 0 (and stage 0's
+    non-first chunks send gradients to the last stage).  The compiled
+    executor (`pipe/interp.py`) lowers these streams exactly like
+    TrainSchedule's, with the ppermute ring closed.
+
+    The known cost: more in-flight activations per stage (a chunk can
+    have up to ~m forwards outstanding at m = 2p) and a larger compiled
+    program (v× the schedule ticks, each 1/v the work) — the standard
+    Megatron memory/bubble trade.
+    """
+
+    def __init__(self, micro_batches, stages, stage_id,
+                 num_virtual_stages=2):
+        super().__init__(micro_batches, stages, stage_id)
+        self.num_virtual_stages = int(num_virtual_stages)
+        if self.num_virtual_stages < 1:
+            raise ValueError(
+                f"num_virtual_stages must be >= 1, got "
+                f"{num_virtual_stages}")
+        if micro_batches % stages:
+            raise ValueError(
+                f"interleaved 1F1B requires micro_batches divisible by "
+                f"stages (microbatch groups of p): got m={micro_batches}"
+                f", p={stages}")
+        # cached: _buffer_of consults this per op and the scan is
+        # O(total ops) — recomputing it per call made steps() quadratic
+        self._per_chunk_buffers = None
+
+    # -- op ordering (Megatron get_forward_backward_func) --------------
+    def _fwd_cm(self, i):
+        p, v = self.stages, self.num_virtual_stages
+        group, within = divmod(i, p * v)
+        vidx, off = divmod(within, p)
+        return vidx, group * p + off
+
+    def _bwd_cm(self, j):
+        p, v = self.stages, self.num_virtual_stages
+        group, within = divmod(j, p * v)
+        vidx = v - 1 - within // p
+        return vidx, group * p + within % p
+
+    def _ops(self):
+        p, v, s = self.stages, self.num_virtual_stages, self.stage_id
+        total = self.micro_batches * v
+        warmup = min((p - s - 1) * 2 + (v - 1) * p, total)
+        ops = [("F", i) for i in range(warmup)]
+        for j in range(total - warmup):
+            ops.append(("F", warmup + j))
+            ops.append(("B", j))
+        for j in range(total - warmup, total):
+            ops.append(("B", j))
+        return ops
+
+    def per_chunk_buffers(self):
+        """Max in-flight forwards of any one chunk on this stage (the
+        saved-input buffer bound per virtual stage); computed once."""
+        if self._per_chunk_buffers is None:
+            live = [0] * self.num_virtual_stages
+            peak = 1
+            for kind, i in self._ops():
+                vidx, _ = self._fwd_cm(i) if kind == "F" \
+                    else self._bwd_cm(i)
+                live[vidx] += 1 if kind == "F" else -1
+                peak = max(peak, live[vidx])
+            self._per_chunk_buffers = peak
+        return self._per_chunk_buffers
+
+    def num_pipe_buffers(self):
+        return self.num_virtual_stages * self.per_chunk_buffers()
+
+    def _buffer_of(self, vidx, mb):
+        # per-chunk in-flight microbatches form a contiguous window of
+        # at most per_chunk_buffers(), so mb mod the bound never
+        # collides
+        return vidx * self.per_chunk_buffers() + \
+            mb % self.per_chunk_buffers()
+
+    def steps(self):
+        p, v, s = self.stages, self.num_virtual_stages, self.stage_id
+        n_chunks = p * v
+        ops = self._ops()
+        for n, (kind, i) in enumerate(ops):
+            if kind == "F":
+                vidx, mb = self._fwd_cm(i)
+                cmds = interleaved_fwd_cmds(s, p, n_chunks, vidx, mb,
+                                            self._buffer_of(vidx, mb))
+            else:
+                cmds = []
+                vidx, mb = self._bwd_cm(i)
+                q = vidx * p + s
+                buf = self._buffer_of(vidx, mb)
+                if q < n_chunks - 1:
+                    cmds.append(RecvGrad(buf, chunk=vidx))
+                cmds.append(BackwardPass(buf, chunk=vidx, mb=mb))
+                if q > 0:
+                    cmds.append(SendGrad(buf, chunk=vidx))
+            if n == len(ops) - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            yield cmds
+
+
 class DataParallelSchedule(PipeSchedule):
     """Pure-DP schedule through the pipeline machinery
     (ref `schedule.py:292-314`)."""

@@ -604,3 +604,31 @@ def test_ds_report_smoke(capsys):
     assert "monitor sinks" in out
     assert "jax version" in out
     assert "Pallas flash attention" in out
+
+
+def test_snapshot_mfu_and_tokens_per_sec(tmp_path):
+    """ISSUE 6 satellite: once the throughput timer has a warmed
+    measurement window, snapshot() (and the fence metrics event) carry
+    the bench-computed tokens_per_sec_per_chip — and mfu on TPU (None
+    on CPU, where no nominal peak applies).  Pre-warmup both keys are
+    present with None (schema stability, not missing keys)."""
+    engine = _engine({"steps_per_print": 4},
+                     monitor={"enabled": True, "sinks": [],
+                              "output_path": str(tmp_path)})
+    snap0 = engine.monitor.snapshot()
+    assert set(snap0) == set(Monitor.SNAPSHOT_KEYS)
+    assert snap0["tokens_per_sec_per_chip"] is None
+    assert snap0["mfu"] is None
+    # steps_per_print=4 -> the tput window fences after ~4 microsteps
+    for i in range(10):
+        engine.train_batch(batch=_make_stacked(i))
+    snap = engine.monitor.snapshot()
+    assert snap["tokens_per_sec_per_chip"] is not None
+    assert snap["tokens_per_sec_per_chip"] > 0
+    import jax
+    if jax.devices()[0].platform != "tpu":
+        assert snap["mfu"] is None   # no nominal CPU peak to divide by
+    # the fence event shares the derived keys
+    event = engine.monitor.on_fence()
+    if event is not None:
+        assert "tokens_per_sec_per_chip" in event and "mfu" in event
