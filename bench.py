@@ -57,7 +57,15 @@ _PEAK_FLOPS = {
 }
 
 
+# --peak-flops CLI override (satellite of ISSUE 7): lets CPU/virtual-
+# mesh rehearsal runs report a meaningful MFU (and mirrors the
+# monitor.peak_flops_override config key for in-loop telemetry).
+_PEAK_FLOPS_OVERRIDE = None
+
+
 def _peak_flops(device) -> float:
+    if _PEAK_FLOPS_OVERRIDE is not None:
+        return _PEAK_FLOPS_OVERRIDE
     kind = getattr(device, "device_kind", "").lower()
     for key, val in _PEAK_FLOPS.items():
         if key in kind:
@@ -1715,6 +1723,139 @@ def bench_monitor_overhead():
     return out
 
 
+def bench_numerics_overhead():
+    """Numerics-health overhead A/B (ISSUE 7): the SAME monitor-enabled
+    async-dispatch loop with monitor.numerics off vs on (per-group grad
+    stats computed inside the jitted step + fence-drained health
+    arrays). The accumulators share the monitor's <3% step-time
+    contract: per-step cost is a few fused reductions inside the
+    already-compiled program plus a list append; per-fence cost rides
+    the SAME single device_get. Paired order-alternating windows,
+    median-of-ratios (the monitor_overhead methodology)."""
+    import shutil
+    import tempfile
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+    from deepspeed_tpu import initialize
+
+    # bigger than the monitor_overhead smoke model: the numerics cost
+    # is ~150 small fused reductions per step (one triple per grad
+    # leaf), a FIXED dispatch cost — on a 17 ms tiny-model step it
+    # reads as several percent of pure overhead-measurement noise,
+    # while any realistic step amortizes it to <<1%. Sizing the model
+    # up makes the leg measure the contract instead of the noise floor.
+    batch, seq = 8, 128
+    steps, warmup, windows = 8, 4, 10
+    # shared-box jitter on a ~300 ms CPU step runs to ±3% per paired
+    # window — the same order as the contract line. When the first
+    # median lands within the noise band of 3%, the leg EXTENDS the
+    # sample (one more batch of windows, overall median) instead of
+    # flaking either way.
+    extend_band = (1.5, 4.5)
+    cfg = tiny_gpt2_config(n_positions=seq, n_layer=4, n_embd=256,
+                           n_head=8, dropout=0.0)
+    tmp = tempfile.mkdtemp(prefix="ds_numerics_bench_")
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg.vocab_size, (1, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    def build(numerics_on):
+        model = GPT2ForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": np.zeros((batch, seq),
+                                                   np.int32)})
+        engine, _, _, _ = initialize(
+            model=model, model_parameters=params,
+            config={
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 100000,
+                "bf16": {"enabled": True},
+                # the flagship-config baseline: clipping means the step
+                # ALREADY reads the grads for a norm, so the numerics
+                # reductions fuse with an existing pass instead of
+                # adding the only one (a no-clip no-fp16 step skips
+                # grad reductions entirely and would charge numerics
+                # the whole first pass)
+                "gradient_clipping": 1.0,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "async_dispatch": {"enabled": True, "steps_per_sync": 5},
+                "monitor": {"enabled": True,
+                            "sinks": ["jsonl"],
+                            "output_path": tmp,
+                            "job_name": "on" if numerics_on else "off",
+                            "numerics": {"enabled": numerics_on}},
+            })
+        del params
+        assert engine._numerics_on == numerics_on
+        for i in range(warmup):
+            loss = engine.train_batch(batch=make_batch(i))
+        _sync(loss)
+        return engine
+
+    def window(engine, base):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = engine.train_batch(batch=make_batch(base + i))
+        _sync(loss)
+        return time.perf_counter() - t0
+
+    out = {}
+    try:
+        engines = {"off": build(False), "on": build(True)}
+        times = {"off": [], "on": []}
+        ratios = []
+
+        def run_windows(n, base):
+            for w in range(n):
+                order = ("off", "on") if w % 2 == 0 else ("on", "off")
+                t = {}
+                for name in order:
+                    t[name] = window(engines[name],
+                                     base + w * steps)
+                times["off"].append(t["off"])
+                times["on"].append(t["on"])
+                ratios.append(t["on"] / t["off"])
+
+        run_windows(windows, 1000)
+        med = float(np.median(ratios))
+        if extend_band[0] <= (med - 1.0) * 100.0 <= extend_band[1]:
+            run_windows(windows, 5000)
+
+        best = {k: min(v) for k, v in times.items()}
+        out = {
+            "model": "gpt2-tiny-smoke (bf16, async dispatch, monitor "
+                     "on both legs, fences every 5 steps)",
+            "off": {"steps_per_sec": round(steps / best["off"], 2),
+                    "step_ms": round(best["off"] * 1e3 / steps, 3)},
+            "on": {"steps_per_sec": round(steps / best["on"], 2),
+                   "step_ms": round(best["on"] * 1e3 / steps, 3)},
+        }
+        overhead = (float(np.median(ratios)) - 1.0) * 100.0
+        out["overhead_pct"] = round(overhead, 2)
+        out["windows_measured"] = len(ratios)
+        out["regressed"] = bool(overhead >= 3.0)
+        # the health stream actually flowed: a numerics event per fence
+        # with per-group grad stats
+        snap = engines["on"].monitor.snapshot()
+        num = snap["numerics"] or {}
+        gn = num.get("grad_norm") or {}
+        out["numerics_groups"] = len(gn)
+        out["first_nonfinite"] = num.get("first_nonfinite")
+        path = os.path.join(tmp, "on", "events.jsonl")
+        out["jsonl_numerics_events"] = sum(
+            1 for line in open(path)
+            if json.loads(line).get("kind") == "numerics")
+        assert out["numerics_groups"] > 0
+        assert out["jsonl_numerics_events"] > 0
+        engines["on"].monitor.close()
+        engines["off"].monitor.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def timeit_once(fn):
     t0 = time.perf_counter()
     fn()
@@ -1728,6 +1869,7 @@ BENCH_LEGS = {
     "async_checkpoint": bench_async_checkpoint,
     "async_dispatch": bench_async_dispatch,
     "monitor_overhead": bench_monitor_overhead,
+    "numerics_overhead": bench_numerics_overhead,
     "gpt2_350m": bench_gpt2_350m,
     "bert_large_fused_seq128": bench_bert_large,
     "flash_head_packing": bench_flash_head_packing,
@@ -1756,7 +1898,16 @@ def main():
     parser.add_argument(
         "--list", action="store_true",
         help="print the valid bench leg names (one per line) and exit")
+    parser.add_argument(
+        "--peak-flops", type=float, default=None, metavar="FLOPS",
+        help="override the per-chip peak FLOP/s used as the MFU "
+             "denominator (e.g. 1.97e14). Makes MFU meaningful on "
+             "CPU/virtual-mesh rehearsal runs; mirrors the "
+             "monitor.peak_flops_override config key")
     args = parser.parse_args()
+    if args.peak_flops is not None:
+        global _PEAK_FLOPS_OVERRIDE
+        _PEAK_FLOPS_OVERRIDE = float(args.peak_flops)
     if args.list:
         for name in sorted(BENCH_LEGS):
             print(name)

@@ -131,6 +131,27 @@ def feature_report():
                      "bias+GeLU)" if ok else f"{FAIL} {mode}"))
     except Exception as e:
         rows.append(("Pallas fused ops", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.monitor.trace_export import TraceExporter  # noqa: F401
+        rows.append(("trace export",
+                     f"{SUCCESS} Perfetto/Chrome trace events "
+                     "(monitor.trace + bin/ds_trace)"))
+    except Exception as e:
+        rows.append(("trace export", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.monitor.flight import FlightRecorder  # noqa: F401
+        rows.append(("flight recorder",
+                     f"{SUCCESS} crash/stall dumps "
+                     "(monitor.flight, flight_<ts>.json)"))
+    except Exception as e:
+        rows.append(("flight recorder", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.monitor import numerics  # noqa: F401
+        rows.append(("numerics health",
+                     f"{SUCCESS} device-side per-layer accumulators "
+                     "(monitor.numerics)"))
+    except Exception as e:
+        rows.append(("numerics health", f"{FAIL} {e}"))
 
     print("-" * 64)
     print("runtime feature report")

@@ -18,7 +18,21 @@ One subsystem, three parts (docs/monitoring.md):
     barrier-per-microstep timers), and a background thread that fires
     when no fence advances within `stall_timeout_sec`.
 
-The Monitor object orchestrates the three against one engine; every
+Forensic layer (ISSUE 7):
+
+  * Perfetto trace export (trace_export.py, `monitor.trace`): span +
+    subsystem tracks and the per-microbatch pipeline timeline from
+    the 1F1B clock tables, merged across ranks by bin/ds_trace.
+  * Flight recorder (flight.py, `monitor.flight`, default on): a
+    bounded ring of the last events + heartbeat ages, dumped
+    atomically on watchdog fire / uncaught train_batch exception /
+    SIGTERM / abnormal exit.
+  * Numerics health (numerics.py, `monitor.numerics`): device-side
+    per-group grad + per-layer activation stats folded inside the
+    jitted step, drained in the same one-device_get-per-fence path,
+    with sticky first-NaN layer attribution.
+
+The Monitor object orchestrates these against one engine; every
 hook is a no-op behind a single attribute check when
 `monitor.enabled` is false (the default).
 """
@@ -29,16 +43,20 @@ import weakref
 
 from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
                                           MonitorConfigError)
+from deepspeed_tpu.monitor.flight import FlightRecorder
 from deepspeed_tpu.monitor.registry import MetricsRegistry
 from deepspeed_tpu.monitor.sinks import (SCHEMA_VERSION, base_event,
                                          build_sinks)
 from deepspeed_tpu.monitor.trace import (SPAN_BACKWARD, SPAN_CKPT,
                                          SPAN_FORWARD, SPAN_PREFETCH,
                                          SPAN_STEP, StepTrace)
+from deepspeed_tpu.monitor.trace_export import (CAT_SUBSYSTEM,
+                                                TraceExporter)
 from deepspeed_tpu.monitor.watchdog import StallWatchdog
 
 __all__ = [
     "Monitor", "MetricsRegistry", "StepTrace", "StallWatchdog",
+    "FlightRecorder", "TraceExporter",
     "DeepSpeedMonitorConfig", "MonitorConfigError", "SCHEMA_VERSION",
     "SPAN_FORWARD", "SPAN_BACKWARD", "SPAN_STEP", "SPAN_CKPT",
     "SPAN_PREFETCH",
@@ -68,12 +86,21 @@ class Monitor:
         self.trace = StepTrace()
         self.sinks = []
         self.watchdog = None
+        self.trace_export = None
+        self.flight = None
         self._armed = False
         self._last_fence_t = None
         self._last_flush_t = 0.0
         self._prefetch_ref = None
         self._cum = {"steps": 0, "overflow_count": 0, "tokens": 0}
         self._last = {}          # most recent drained window metrics
+        self._last_numerics = None
+        self._first_nonfinite = None   # sticky first-NaN attribution
+        # host-side heartbeat mirror (ages for the flight recorder even
+        # when no watchdog is configured)
+        self._hb = {}
+        self._hb_terminal = set()
+        self._numerics_names = {"grad": None, "act": None}
         # gauges register even when disabled so snapshot() keeps its
         # stable key set on a monitor-off engine
         self._register_default_gauges()
@@ -81,19 +108,51 @@ class Monitor:
             return
 
         import jax
-        rank0 = jax.process_index() == 0
+        rank = jax.process_index()
+        rank0 = rank == 0
+        out_dir = config.output_path or _MONITOR_OUTPUT_DEFAULT
+        if config.job_name:
+            out_dir = os.path.join(out_dir, config.job_name)
+        self._out_dir = out_dir
         if rank0 or config.all_ranks:
-            out_dir = config.output_path or _MONITOR_OUTPUT_DEFAULT
             job = config.job_name
             if config.all_ranks and not rank0:
-                job = os.path.join(job or "",
-                                   f"rank{jax.process_index()}")
-            self.sinks = build_sinks(config.sinks, out_dir, job)
+                job = os.path.join(job or "", f"rank{rank}")
+            self.sinks = build_sinks(
+                config.sinks, config.output_path or
+                _MONITOR_OUTPUT_DEFAULT, job)
+        if config.trace_enabled and (rank0 or config.all_ranks):
+            self.trace_export = TraceExporter(
+                rank=rank, max_events=config.trace_max_events,
+                meta={"job_name": config.job_name})
+            self.trace.set_export_sink(
+                lambda name, t0, dur: self.trace_export.complete(
+                    f"host/{name}", name, t0, dur))
+        if config.flight_enabled:
+            self.flight = FlightRecorder(
+                out_dir=config.flight_path or out_dir,
+                capacity=config.flight_capacity,
+                rank=rank,
+                step_fn=self._flight_step,
+                heartbeats_fn=self._heartbeat_state)
         if config.stall_timeout_sec > 0:
             self.watchdog = StallWatchdog(
                 config.stall_timeout_sec,
                 probe=config.stall_probe,
                 emit=self._emit_kind)
+
+    def _flight_step(self):
+        e = self._engine_ref()
+        return e._host_steps if e is not None else None
+
+    def _heartbeat_state(self):
+        """(age per ACTIVE subsystem, terminal list) from the monitor's
+        own heartbeat mirror — available to the flight recorder with or
+        without a watchdog."""
+        now = time.monotonic()
+        return ({src: round(now - t, 3) for src, t in self._hb.items()
+                 if src not in self._hb_terminal},
+                sorted(self._hb_terminal))
 
     # ------------------------------------------------------------------
     # gauges
@@ -124,30 +183,67 @@ class Monitor:
         self._prefetch_ref = weakref.ref(loader)
 
     def heartbeat(self, source):
+        self._hb[source] = time.monotonic()
+        self._hb_terminal.discard(source)
         if self.watchdog is not None:
             self.watchdog.heartbeat(source)
+
+    def heartbeat_done(self, source):
+        """A subsystem finished cleanly (e.g. the prefetch worker after
+        its source exhausted): its heartbeat goes terminal — excluded
+        from stall verdicts, listed as finished in diagnostics."""
+        self._hb_terminal.add(source)
+        if self.watchdog is not None:
+            self.watchdog.mark_terminal(source)
+
+    def subsystem_span(self, track, name, t_start, dur, args=None):
+        """Stamp one host-subsystem slice (prefetch staging, ckpt
+        commit, offload host step) onto the Perfetto timeline.
+        Thread-safe, no-op without trace export."""
+        if self.trace_export is not None:
+            self.trace_export.complete(track, name, t_start, dur,
+                                       cat=CAT_SUBSYSTEM, args=args)
+
+    def set_numerics_labels(self, grad=None, act=None):
+        """Host-side names for the numerics stat rows: `grad` labels
+        the [G,3] gradient-group rows, `act` the [L,3] activation
+        boundary rows (the engine knows both at build time)."""
+        if grad is not None:
+            self._numerics_names["grad"] = list(grad)
+        if act is not None:
+            self._numerics_names["act"] = list(act)
+
+    @property
+    def numerics_enabled(self):
+        return self.enabled and self.config.numerics_enabled
 
     # ------------------------------------------------------------------
     # hot path
     # ------------------------------------------------------------------
     def on_step(self, loss=None, grad_norm=None, loss_scale=None,
-                overflow=None, tokens=0, wire_stats=None):
+                overflow=None, tokens=0, wire_stats=None, health=None):
         """Fold one step's metrics. Device scalars stay on device (one
-        async jitted add); host numbers go to counters. NO host<->
-        device sync on this path — the fence-alignment guard test pins
-        it."""
+        async jitted add); host numbers go to counters; `health`
+        (numerics stat arrays, monitor/numerics.py) is retained the
+        same way. NO host<->device sync on this path — the
+        fence-alignment guard test pins it."""
         if not self.enabled:
             return
         self.registry.fold_step(loss, grad_norm, loss_scale, overflow,
-                                tokens)
+                                tokens, health=health)
         if wire_stats:
             self.registry.inc("wire/d2h_bytes",
                               wire_stats.get("d2h_bytes", 0))
             self.registry.inc("wire/h2d_bytes",
                               wire_stats.get("h2d_bytes", 0))
-        if not self._armed and self.watchdog is not None:
+        if not self._armed:
             self._armed = True
-            self.watchdog.arm()
+            if self.watchdog is not None:
+                self.watchdog.arm()
+            if self.flight is not None:
+                # armed = the engine actually trained; an abnormal exit
+                # from here on leaves a flight dump
+                self.flight.arm()
 
     # ------------------------------------------------------------------
     # fence drain
@@ -188,7 +284,13 @@ class Monitor:
         tps_chip = sps * t_per_sample / max(len(jax.devices()), 1)
         mfu = None
         n = getattr(e, "_n_model_params", 0)
-        if n and jax.devices()[0].platform == "tpu":
+        override = self.config.peak_flops_override
+        if n and override:
+            # monitor.peak_flops_override: report MFU against the
+            # caller's denominator on ANY backend — CPU/virtual-mesh
+            # rehearsal runs get a real number instead of None
+            mfu = round(6.0 * n * tps_chip / override, 4)
+        elif n and jax.devices()[0].platform == "tpu":
             from deepspeed_tpu.profiling.flops_profiler.profiler import \
                 device_peak_specs
             peak, _ = device_peak_specs()
@@ -215,6 +317,7 @@ class Monitor:
         if window is None:
             self._maybe_flush()
             return None
+        numerics = self._summarize_numerics(window)
         self._last = window
         self._cum["steps"] += window["steps"]
         self._cum["overflow_count"] += window["overflow_count"]
@@ -255,14 +358,67 @@ class Monitor:
         spans = self.trace.drain()
         if spans:
             event["spans"] = spans
+        if self.trace_export is not None:
+            # fence marks + counter tracks: loss/throughput ride the
+            # Perfetto timeline next to the span and pipeline slices
+            vals = {k: event[k] for k in
+                    ("loss", "grad_norm", "tokens_per_sec",
+                     "samples_per_sec")
+                    if isinstance(event.get(k), (int, float))}
+            if vals:
+                self.trace_export.counter("fences", "metrics", vals)
+            self.trace_export.instant(
+                "fences", f"fence step {event['step']}",
+                args={"window_steps": event.get("window_steps")})
         self._emit(event)
+        if numerics is not None:
+            num_event = base_event("numerics", e._host_steps)
+            num_event.update(numerics)
+            self._emit(num_event)
         self._maybe_flush()
         return event
+
+    def _summarize_numerics(self, window):
+        """Summarize (and strip) a drained window's raw health data —
+        fetched numpy from the fence's single device_get — into the
+        `numerics` event fields; updates the flight recorder's sticky
+        first-NaN context."""
+        health = window.pop("health", None)
+        if health is None:
+            return None
+        from deepspeed_tpu.monitor import numerics as num_mod
+        entries, acc = health
+        summary = num_mod.summarize_window(
+            entries, acc,
+            grad_names=self._numerics_names["grad"],
+            act_names=self._numerics_names["act"])
+        if summary is None:
+            return None
+        self._last_numerics = summary
+        if summary.get("first_nonfinite") and \
+                self._first_nonfinite is None:
+            # sticky FIRST occurrence: once a NaN poisons the params,
+            # every later window blames layer 0 — the forensic answer
+            # is the window where it first appeared
+            e = self._engine_ref()
+            self._first_nonfinite = dict(
+                summary["first_nonfinite"],
+                step=e._host_steps if e else None)
+        if self.flight is not None:
+            ctx = {"numerics": summary}
+            if self._first_nonfinite is not None:
+                ctx["first_nonfinite"] = self._first_nonfinite
+            self.flight.set_context(**ctx)
+        return summary
 
     # ------------------------------------------------------------------
     # events / sinks
     # ------------------------------------------------------------------
     def _emit(self, event):
+        if self.flight is not None:
+            # the ring retains what the sinks saw — the dump IS the
+            # tail of the event stream
+            self.flight.record(event)
         for sink in self.sinks:
             try:
                 sink.emit(event)
@@ -277,9 +433,71 @@ class Monitor:
         event = base_event(kind, e._host_steps if e else 0)
         event.update(fields)
         self._emit(event)
+        if kind == "ckpt_commit" and self.trace_export is not None:
+            # the commit just finished ON the writer thread: a slice of
+            # wall_ms ending now on the ckpt-writer track
+            wall = float(fields.get("wall_ms") or 0.0) / 1e3
+            self.trace_export.complete(
+                "ckpt_writer", f"commit {fields.get('tag', '')}",
+                time.perf_counter() - wall, wall, cat=CAT_SUBSYSTEM,
+                args={"tag": fields.get("tag")})
+        if kind == "stall":
+            # the forensic moment: freeze the evidence while the run is
+            # still (maybe) wedged — flight dump + trace export
+            if self.flight is not None:
+                try:
+                    self.flight.dump("stall", extra=fields)
+                except Exception:
+                    pass
+            self._export_trace_safe()
 
     def event(self, kind, **fields):
         self._emit_kind(kind, fields)
+
+    def on_crash(self, exc):
+        """Uncaught exception out of the step loop: record it and dump
+        the flight ring + trace before the exception propagates."""
+        if not self.enabled:
+            return
+        if self.flight is not None:
+            try:
+                self.flight.record_exception(exc)
+                self.flight.dump("exception",
+                                 extra={"error": repr(exc)})
+            except Exception:
+                pass
+        self._export_trace_safe()
+
+    # ------------------------------------------------------------------
+    # trace export
+    # ------------------------------------------------------------------
+    def trace_path(self):
+        import jax
+        rank = jax.process_index()
+        if self.config.trace_path:
+            # explicit path: rank 0 gets it verbatim; other ranks get a
+            # rank-suffixed sibling — every rank writing the SAME file
+            # would clobber the shards ds_trace merge needs
+            if rank == 0:
+                return self.config.trace_path
+            stem, ext = os.path.splitext(self.config.trace_path)
+            return f"{stem}_rank{rank}{ext or '.json'}"
+        return os.path.join(
+            getattr(self, "_out_dir", _MONITOR_OUTPUT_DEFAULT),
+            f"trace_rank{rank}.json")
+
+    def export_trace(self, path=None):
+        """Write the Perfetto trace file (atomic) and return its path;
+        None when trace export is off."""
+        if self.trace_export is None:
+            return None
+        return self.trace_export.write(path or self.trace_path())
+
+    def _export_trace_safe(self):
+        try:
+            self.export_trace()
+        except Exception:
+            pass
 
     def _maybe_flush(self):
         now = time.monotonic()
@@ -298,7 +516,7 @@ class Monitor:
         "schema", "enabled", "step", "micro_steps", "loss", "grad_norm",
         "loss_scale", "lr", "overflow_count", "tokens",
         "samples_per_sec", "tokens_per_sec_per_chip", "mfu",
-        "memory", "wire", "checkpoint", "prefetch",
+        "memory", "wire", "checkpoint", "prefetch", "numerics",
     )
 
     def snapshot(self):
@@ -309,6 +527,7 @@ class Monitor:
         e = self._engine_ref()
         window = self.registry.drain_device()
         if window is not None:
+            self._summarize_numerics(window)
             self._last = window
             self._cum["steps"] += window["steps"]
             self._cum["overflow_count"] += window["overflow_count"]
@@ -344,6 +563,7 @@ class Monitor:
                 "occupancy": gauges.get("prefetch/occupancy"),
                 "depth": gauges.get("prefetch/depth"),
             },
+            "numerics": self._last_numerics,
         }
         return snap
 
@@ -352,6 +572,10 @@ class Monitor:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self.flight is not None:
+            # clean shutdown: no atexit dump for this engine
+            self.flight.disarm()
+        self._export_trace_safe()
         for sink in self.sinks:
             try:
                 sink.flush()

@@ -24,6 +24,20 @@ stall_probe: on a stall, also time an `effects_barrier` on a
   sacrificial thread to tell a wedged device from a stalled host.
 all_ranks: emit events from every process (default: rank 0 only, with
   a per-rank filename suffix when enabled).
+peak_flops_override: MFU denominator in FLOP/s per chip (0 = auto:
+  nominal TPU peak on real chips, None off-TPU). Makes MFU and
+  tokens_per_sec_per_chip meaningful on CPU/virtual-mesh runs.
+trace: {"enabled", "path", "max_events"} — Perfetto/Chrome
+  trace-event export (monitor/trace_export.py): fence-aligned spans +
+  the per-microbatch pipeline timeline, written at close()/watchdog
+  fire/export_trace(), merged across ranks by bin/ds_trace.
+flight: {"enabled" (default true), "capacity", "path"} — crash/stall
+  flight recorder (monitor/flight.py): the last N events + heartbeat
+  ages, dumped atomically on watchdog fire / uncaught train_batch
+  exception / SIGTERM / abnormal exit.
+numerics: {"enabled"} — device-side per-layer numerics health
+  (monitor/numerics.py): per-group grad stats + per-layer activation
+  stats folded inside the jitted step, drained at the same fences.
 """
 
 from deepspeed_tpu.runtime import constants as C
@@ -77,3 +91,52 @@ class DeepSpeedMonitorConfig:
             block, C.MONITOR_STALL_PROBE, C.MONITOR_STALL_PROBE_DEFAULT))
         self.all_ranks = bool(get_scalar_param(
             block, C.MONITOR_ALL_RANKS, C.MONITOR_ALL_RANKS_DEFAULT))
+        self.peak_flops_override = float(get_scalar_param(
+            block, C.MONITOR_PEAK_FLOPS_OVERRIDE,
+            C.MONITOR_PEAK_FLOPS_OVERRIDE_DEFAULT))
+        if self.peak_flops_override < 0:
+            raise MonitorConfigError(
+                "monitor.peak_flops_override must be >= 0 (0 = auto), "
+                f"got {self.peak_flops_override}")
+
+        trace = block.get(C.MONITOR_TRACE, {})
+        if not isinstance(trace, dict):
+            raise MonitorConfigError(
+                f'"monitor.trace" must be a dict, got {trace!r}')
+        self.trace_enabled = bool(get_scalar_param(
+            trace, C.MONITOR_TRACE_ENABLED,
+            C.MONITOR_TRACE_ENABLED_DEFAULT))
+        self.trace_path = get_scalar_param(
+            trace, C.MONITOR_TRACE_PATH, C.MONITOR_TRACE_PATH_DEFAULT)
+        self.trace_max_events = int(get_scalar_param(
+            trace, C.MONITOR_TRACE_MAX_EVENTS,
+            C.MONITOR_TRACE_MAX_EVENTS_DEFAULT))
+        if self.trace_max_events <= 0:
+            raise MonitorConfigError(
+                "monitor.trace.max_events must be > 0, got "
+                f"{self.trace_max_events}")
+
+        flight = block.get(C.MONITOR_FLIGHT, {})
+        if not isinstance(flight, dict):
+            raise MonitorConfigError(
+                f'"monitor.flight" must be a dict, got {flight!r}')
+        self.flight_enabled = bool(get_scalar_param(
+            flight, C.MONITOR_FLIGHT_ENABLED,
+            C.MONITOR_FLIGHT_ENABLED_DEFAULT))
+        self.flight_capacity = int(get_scalar_param(
+            flight, C.MONITOR_FLIGHT_CAPACITY,
+            C.MONITOR_FLIGHT_CAPACITY_DEFAULT))
+        if self.flight_capacity <= 0:
+            raise MonitorConfigError(
+                "monitor.flight.capacity must be > 0, got "
+                f"{self.flight_capacity}")
+        self.flight_path = get_scalar_param(
+            flight, C.MONITOR_FLIGHT_PATH, C.MONITOR_FLIGHT_PATH_DEFAULT)
+
+        numerics = block.get(C.MONITOR_NUMERICS, {})
+        if not isinstance(numerics, dict):
+            raise MonitorConfigError(
+                f'"monitor.numerics" must be a dict, got {numerics!r}')
+        self.numerics_enabled = bool(get_scalar_param(
+            numerics, C.MONITOR_NUMERICS_ENABLED,
+            C.MONITOR_NUMERICS_ENABLED_DEFAULT))

@@ -1,0 +1,332 @@
+"""Device-side numerics health: per-layer/per-group accumulators with
+first-NaN attribution.
+
+A loss blow-up's post-mortem question is never "did it NaN" (the
+overflow flag says so) but "WHERE did it first NaN" — which layer's
+activations, which parameter group's gradients. Answering that with
+host-side inspection would re-synchronize the hot path per step;
+instead the stats are computed INSIDE the jitted step, on tensors the
+step already materializes:
+
+  * activation stats — (abs-max, mean|x|, nonfinite count) at every
+    layer boundary of layer-exposing models (PipelineModule's chained
+    loss taps each boundary); a layer whose input stats are finite and
+    whose output stats are not is the first-NaN layer;
+  * gradient stats — (L2 norm, abs-max, nonfinite count) per top-level
+    parameter group, computed on the unscaled gradients right before
+    the overflow vote — the "overflow source" per group.
+
+The per-step cost is a few fused reductions over tensors already in
+registers/HBM, and the outputs are tiny device arrays ([L,3]/[G,3])
+the registry RETAINS exactly like the loss scalar — a list append, no
+dispatch, no sync — and drains in the same single per-fence
+`device_get` (the guard test pins zero new per-step syncs). Long
+windows compact through `fold_entries` (a handful of eager jnp reduces
+alongside the registry's scalar compaction), which preserves the
+first-nonfinite (window-step, kind, index) candidate on device before
+per-step granularity is discarded.
+
+Stats layout (always float32):
+  activation rows: [absmax, mean_abs, nonfinite_count]
+  gradient rows:   [l2_norm, absmax, nonfinite_flag]  (0/1 per step;
+                   window-summed it counts affected steps — the flag
+                   derives free from the two reductions, see
+                   grad_group_stats)
+"""
+
+import numpy as np
+
+KIND_ACT = 0
+KIND_GRAD = 1
+
+ACT_COLS = ("absmax", "mean_abs", "nonfinite")
+GRAD_COLS = ("norm", "absmax", "nonfinite")
+
+
+# ----------------------------------------------------------------------
+# inside-jit stat computation
+# ----------------------------------------------------------------------
+def tensor_stats(x):
+    """[3] f32 activation stats for one boundary tensor: abs-max,
+    mean|x|, nonfinite count. Reductions only — no data-dependent
+    control flow, so they trace into any jitted step."""
+    import jax.numpy as jnp
+    xf = x.astype(jnp.float32)
+    ax = jnp.abs(xf)
+    return jnp.stack([
+        jnp.max(ax),
+        jnp.mean(ax),
+        jnp.sum(~jnp.isfinite(xf)).astype(jnp.float32),
+    ])
+
+
+def stack_act_stats(per_layer):
+    """[L, 3] from a list of per-boundary tensor_stats vectors."""
+    import jax.numpy as jnp
+    return jnp.stack(per_layer)
+
+
+def combine_act_microbatches(acts):
+    """Reduce [gas, L, 3] per-microbatch activation stats to [L, 3]:
+    absmax -> max, mean_abs -> mean, nonfinite -> sum."""
+    import jax.numpy as jnp
+    return jnp.stack([
+        jnp.max(acts[..., 0], axis=0),
+        jnp.mean(acts[..., 1], axis=0),
+        jnp.sum(acts[..., 2], axis=0),
+    ], axis=-1)
+
+
+def group_paths(tree, depth=2):
+    """Ordered leaf-group names: leaves grouped by the first `depth`
+    path components (host-side; tree structure is static, so the same
+    call inside a trace yields the same grouping)."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    names, seen = [], set()
+    for path, _leaf in flat:
+        name = _path_prefix(path, depth)
+        if name not in seen:
+            seen.add(name)
+            names.append(name)
+    return names
+
+
+def _path_prefix(path, depth):
+    import jax
+    parts = []
+    for entry in path[:depth]:
+        s = jax.tree_util.keystr((entry,))
+        parts.append(s.strip("[]'\""))
+    return "/".join(parts) if parts else "<root>"
+
+
+def leaf_sumsq(tree):
+    """Per-leaf fused sum-of-squares tree (f32) — computed ONCE in the
+    step and shared between the engine's global grad norm and the
+    per-group stats below, so numerics health does not re-read the
+    gradients for a second norm pass."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        # sum(g*g), NOT vdot: vdot lowers to a dot over a flattened
+        # f32 copy of each leaf, while the elementwise square fuses
+        # straight into the reduction
+        lambda g: jnp.sum(g.astype(jnp.float32) ** 2), tree)
+
+
+def grad_group_stats(grads, sq_tree=None, depth=2):
+    """[G, 3] f32 per-group gradient stats (groups = group_paths order):
+    L2 norm, abs-max, nonfinite FLAG (0/1 — summed over a window it
+    counts affected steps). Called inside the jitted step on the
+    unscaled grads; ZeRO's padded encoding is stats-neutral (pad lanes
+    are zeros: finite, zero-norm contribution).
+
+    Cost discipline: the sum-of-squares pass is SHARED with the
+    engine's clip/overflow grad norm (`sq_tree` = leaf_sumsq output),
+    so with clipping or fp16 enabled numerics adds exactly ONE new
+    reduction pass per leaf (abs-max); NaN/Inf propagate through both
+    reductions, so the nonfinite flag is a free scalar derivation
+    instead of a third full `isfinite` sweep over every parameter
+    (the sweep alone showed up as measurable step-time overhead in the
+    `numerics_overhead` A/B). Activation stats keep exact element
+    counts — they run on L boundary tensors, not every parameter."""
+    import jax
+    import jax.numpy as jnp
+    if sq_tree is None:
+        sq_tree = leaf_sumsq(grads)
+    flat, _ = jax.tree_util.tree_flatten_with_path(grads)
+    sq_flat = jax.tree_util.tree_leaves(sq_tree)
+    groups = {}
+    order = []
+    for (path, leaf), sq in zip(flat, sq_flat):
+        name = _path_prefix(path, depth)
+        if name not in groups:
+            groups[name] = []
+            order.append(name)
+        groups[name].append((leaf, sq))
+    rows = []
+    for name in order:
+        sq = jnp.sum(jnp.stack([s for _, s in groups[name]]))
+        absmax = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(leaf.astype(jnp.float32)))
+             for leaf, _ in groups[name]]))
+        bad = (~(jnp.isfinite(sq) & jnp.isfinite(absmax))) \
+            .astype(jnp.float32)
+        rows.append(jnp.stack([jnp.sqrt(sq), absmax, bad]))
+    return jnp.stack(rows)
+
+
+# ----------------------------------------------------------------------
+# window compaction (device-side, eager — runs with the registry's
+# scalar compaction every _COMPACT_AT retained steps)
+# ----------------------------------------------------------------------
+def _first_bad_of_block(steps, acts, grads):
+    """Device [3] i32 candidate (win_step, kind, index) for the first
+    nonfinite in a block of retained entries; win_step == -1 when the
+    whole block is finite. Activations outrank gradients within a step
+    (the forward runs first)."""
+    import jax.numpy as jnp
+    n = len(steps)
+    steps = jnp.asarray(steps, jnp.int32)
+    act_bad = jnp.zeros((n,), bool) if acts is None \
+        else jnp.any(acts[..., 2] > 0, axis=-1)
+    grad_bad = jnp.zeros((n,), bool) if grads is None \
+        else jnp.any(grads[..., 2] > 0, axis=-1)
+    any_bad = act_bad | grad_bad
+    has = jnp.any(any_bad)
+    n0 = jnp.argmax(any_bad)           # first True
+    kind = jnp.where(act_bad[n0], KIND_ACT, KIND_GRAD)
+    idx_act = jnp.int32(0) if acts is None else \
+        jnp.argmax(acts[n0, :, 2] > 0).astype(jnp.int32)
+    idx_grad = jnp.int32(0) if grads is None else \
+        jnp.argmax(grads[n0, :, 2] > 0).astype(jnp.int32)
+    idx = jnp.where(kind == KIND_ACT, idx_act, idx_grad)
+    return jnp.where(
+        has,
+        jnp.stack([steps[n0], kind.astype(jnp.int32), idx]),
+        jnp.asarray([-1, -1, -1], jnp.int32))
+
+
+def fold_entries(steps, healths, acc):
+    """Reduce a block of retained (win_step, health) entries into the
+    running device accumulator. health = {"act": [L,3]|None,
+    "grad": [G,3]|None} with constant presence within one engine run.
+    Eager jnp only — async like the step, never a sync."""
+    import jax.numpy as jnp
+    acts = None
+    grads = None
+    if healths and healths[0].get("act") is not None:
+        acts = jnp.stack([h["act"] for h in healths])
+    if healths and healths[0].get("grad") is not None:
+        grads = jnp.stack([h["grad"] for h in healths])
+    new = {
+        "act_last": None if acts is None else acts[-1],
+        "act_absmax": None if acts is None
+        else jnp.max(acts[..., 0], axis=0),
+        "act_nonfinite": None if acts is None
+        else jnp.sum(acts[..., 2], axis=0),
+        "grad_last": None if grads is None else grads[-1],
+        "grad_absmax": None if grads is None
+        else jnp.max(grads[..., 1], axis=0),
+        "grad_nonfinite": None if grads is None
+        else jnp.sum(grads[..., 2], axis=0),
+        "first_bad": _first_bad_of_block(steps, acts, grads),
+    }
+    if acc is None:
+        return new
+    out = dict(new)
+    for key in ("act_absmax", "grad_absmax"):
+        if acc.get(key) is not None and new.get(key) is not None:
+            out[key] = jnp.maximum(acc[key], new[key])
+    for key in ("act_nonfinite", "grad_nonfinite"):
+        if acc.get(key) is not None and new.get(key) is not None:
+            out[key] = acc[key] + new[key]
+    # the EARLIER candidate wins (acc covers earlier window steps)
+    prev = acc["first_bad"]
+    out["first_bad"] = jnp.where(prev[0] >= 0, prev, new["first_bad"])
+    return out
+
+
+# ----------------------------------------------------------------------
+# host-side fence summary (runs on fetched numpy, after the one
+# per-fence device_get)
+# ----------------------------------------------------------------------
+def _named(names, values, as_int=False):
+    if values is None:
+        return None
+    vals = np.asarray(values)
+    names = list(names) if names else \
+        [f"group{i}" for i in range(len(vals))]
+    cast = int if as_int else float
+    return {names[i] if i < len(names) else f"group{i}": cast(vals[i])
+            for i in range(len(vals))}
+
+
+def summarize_window(entries, acc, grad_names=None, act_names=None):
+    """The fence's numerics event fields, from the fetched (numpy)
+    pending entries + compacted accumulator. Returns None when the
+    window held no health data."""
+    if not entries and acc is None:
+        return None
+    steps = [s for s, _ in entries]
+    acts = [h["act"] for _, h in entries
+            if h.get("act") is not None]
+    grads = [h["grad"] for _, h in entries
+            if h.get("grad") is not None]
+    acts = np.stack(acts) if acts else None
+    grads = np.stack(grads) if grads else None
+
+    def _merge(tail_last, tail_red, acc_last, acc_red, how):
+        """tail (post-compaction entries) takes `last`; reductions
+        merge with the accumulated block."""
+        last = tail_last if tail_last is not None else acc_last
+        reds = [r for r in (tail_red, acc_red) if r is not None]
+        red = None if not reds else \
+            (np.maximum.reduce(reds) if how == "max" else sum(reds))
+        return last, red
+
+    act_last, act_absmax = _merge(
+        None if acts is None else acts[-1],
+        None if acts is None else acts[..., 0].max(axis=0),
+        None if acc is None else acc.get("act_last"),
+        None if acc is None else acc.get("act_absmax"), "max")
+    _, act_bad = _merge(
+        None,
+        None if acts is None else acts[..., 2].sum(axis=0),
+        None,
+        None if acc is None else acc.get("act_nonfinite"), "sum")
+    grad_last, grad_absmax = _merge(
+        None if grads is None else grads[-1],
+        None if grads is None else grads[..., 1].max(axis=0),
+        None if acc is None else acc.get("grad_last"),
+        None if acc is None else acc.get("grad_absmax"), "max")
+    _, grad_bad = _merge(
+        None,
+        None if grads is None else grads[..., 2].sum(axis=0),
+        None,
+        None if acc is None else acc.get("grad_nonfinite"), "sum")
+
+    # first-nonfinite: the compacted candidate covers earlier steps
+    first = None
+    if acc is not None and acc.get("first_bad") is not None:
+        fb = np.asarray(acc["first_bad"])
+        if fb[0] >= 0:
+            first = (int(fb[0]), int(fb[1]), int(fb[2]))
+    if first is None and entries:
+        for (step, h) in entries:
+            a = h.get("act")
+            if a is not None and (np.asarray(a)[:, 2] > 0).any():
+                first = (int(step), KIND_ACT,
+                         int(np.argmax(np.asarray(a)[:, 2] > 0)))
+                break
+            g = h.get("grad")
+            if g is not None and (np.asarray(g)[:, 2] > 0).any():
+                first = (int(step), KIND_GRAD,
+                         int(np.argmax(np.asarray(g)[:, 2] > 0)))
+                break
+
+    out = {
+        "grad_norm": _named(grad_names,
+                            None if grad_last is None
+                            else np.asarray(grad_last)[:, 0]),
+        "grad_absmax": _named(grad_names, grad_absmax),
+        "grad_nonfinite": _named(grad_names, grad_bad, as_int=True),
+        "act_absmax": _named(act_names, act_absmax),
+        "act_mean": _named(act_names,
+                           None if act_last is None
+                           else np.asarray(act_last)[:, 1]),
+        "act_nonfinite": _named(act_names, act_bad, as_int=True),
+        "window_steps": len(steps),
+    }
+    if first is not None:
+        step, kind, idx = first
+        names = act_names if kind == KIND_ACT else grad_names
+        name = names[idx] if names and idx < len(names) else str(idx)
+        out["first_nonfinite"] = {
+            "kind": "activation" if kind == KIND_ACT else "gradient",
+            "name": name, "index": idx, "window_step": step,
+        }
+    else:
+        out["first_nonfinite"] = None
+    return out

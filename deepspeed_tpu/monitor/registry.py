@@ -45,11 +45,18 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters = {}
         self._gauges = {}
+        # numerics health (monitor/numerics.py): per-step [L,3]/[G,3]
+        # device stat arrays retained exactly like the scalars — a list
+        # append per step, compacted on device, fetched in the SAME
+        # per-fence device_get
+        self._pending_health = []   # [(window_step, {"act","grad"})]
+        self._health_acc = None
 
     # ------------------------------------------------------------------
     # device-side accumulator
     # ------------------------------------------------------------------
-    def fold_step(self, loss, grad_norm, loss_scale, overflow, tokens):
+    def fold_step(self, loss, grad_norm, loss_scale, overflow, tokens,
+                  health=None):
         """Retain one step's device scalars. NO device work, NO sync —
         a list append; the buffers were produced by the step anyway.
         (Never `bool()`/`float()` a device value here: that would be a
@@ -58,10 +65,16 @@ class MetricsRegistry:
         A None loss/grad_norm (backward(release_loss=True) loops, paths
         that skip the norm) folds as 0 on device but is EXCLUDED from
         the window mean — reporting a bogus 0.0 loss would read as
-        sudden convergence on a dashboard."""
+        sudden convergence on a dashboard.
+
+        `health` ({"act": [L,3], "grad": [G,3]} device arrays, either
+        key possibly None) retains numerics-health stats the same way."""
         self._pending.append((0.0 if loss is None else loss,
                               0.0 if grad_norm is None else grad_norm,
                               False if overflow is None else overflow))
+        if health is not None and (health.get("act") is not None or
+                                   health.get("grad") is not None):
+            self._pending_health.append((self._steps, health))
         if loss is not None:
             self._loss_steps += 1
         if grad_norm is not None:
@@ -89,6 +102,12 @@ class MetricsRegistry:
         if self._acc is not None:
             part = tuple(a + p for a, p in zip(self._acc, part))
         self._acc = part
+        if self._pending_health:
+            from deepspeed_tpu.monitor import numerics
+            ph, self._pending_health = self._pending_health, []
+            self._health_acc = numerics.fold_entries(
+                [s for s, _ in ph], [h for _, h in ph],
+                self._health_acc)
 
     # ------------------------------------------------------------------
     # host-side counters + gauges
@@ -134,13 +153,15 @@ class MetricsRegistry:
         if self._steps == 0:
             return None
         import jax
-        acc, pend, scale = jax.device_get(
-            (self._acc, self._pending, self._scale_last))
+        acc, pend, scale, health_acc, pend_health = jax.device_get(
+            (self._acc, self._pending, self._scale_last,
+             self._health_acc, self._pending_health))
         steps, self._steps = self._steps, 0
         loss_steps, self._loss_steps = self._loss_steps, 0
         gnorm_steps, self._gnorm_steps = self._gnorm_steps, 0
         tokens, self._tokens = self._tokens, 0.0
         self._pending, self._acc = [], None
+        self._pending_health, self._health_acc = [], None
 
         loss_sum = gnorm_sum = ovf_sum = 0.0
         if acc is not None:
@@ -154,7 +175,7 @@ class MetricsRegistry:
         # loss_scale persists across windows (the next window may hold
         # only overflow-skipped steps that never touch the scale)
         self._scale_last = scale
-        return {
+        out = {
             "steps": int(steps),
             "loss": loss_sum / loss_steps if loss_steps else None,
             "grad_norm": gnorm_sum / gnorm_steps if gnorm_steps
@@ -163,3 +184,8 @@ class MetricsRegistry:
             "overflow_count": int(ovf_sum),
             "tokens": int(tokens),
         }
+        if pend_health or health_acc is not None:
+            # fetched numpy already (it rode the fused device_get
+            # above); the Monitor summarizes with its host-side labels
+            out["health"] = (pend_health, health_acc)
+        return out

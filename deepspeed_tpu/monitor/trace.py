@@ -74,6 +74,13 @@ class StepTrace:
         self._lock = threading.Lock()
         self._totals = {}
         self._counts = {}
+        self._export = None      # (name, t0, dur) hook -> TraceExporter
+
+    def set_export_sink(self, fn):
+        """Route every closed span to the Perfetto exporter as well
+        (monitor/trace_export.py) — spans are timed once, rendered in
+        both the fence metrics and the trace file."""
+        self._export = fn
 
     def start(self, name):
         self._open[name] = _Span(name)
@@ -91,6 +98,11 @@ class StepTrace:
         with self._lock:
             self._totals[name] = self._totals.get(name, 0.0) + dt
             self._counts[name] = self._counts.get(name, 0) + 1
+        if self._export is not None:
+            try:
+                self._export(name, sp.t0, dt)
+            except Exception:
+                pass
 
     def span(self, name):
         return _SpanCtx(self, name)

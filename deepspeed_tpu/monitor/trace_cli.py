@@ -1,0 +1,100 @@
+"""`ds_trace` — merge and summarize Perfetto trace shards.
+
+    ds_trace merge runA/trace_rank*.json -o merged.json
+    ds_trace summary runA/trace_rank0.json [more.json ...]
+
+`merge` concatenates per-rank shards (events are rank-tagged by `pid`
+and anchored on the unix clock, so concatenation + sort IS the merge)
+into one file Perfetto opens as a multi-rank timeline. `summary`
+prints per-track busy/occupancy and — when pipeline events are present
+— the measured bubble fraction next to the schedule's analytic
+(p-1)/(v·m+p-1), the number the interleaved-1F1B work exists to move.
+"""
+
+import argparse
+import json
+import sys
+
+from deepspeed_tpu.monitor.trace_export import (load_trace,
+                                                merge_traces,
+                                                summarize_trace)
+
+
+def _cmd_merge(args):
+    docs = [load_trace(p) for p in args.paths]
+    merged = merge_traces(docs)
+    out = args.output or "trace_merged.json"
+    with open(out, "w") as f:
+        json.dump(merged, f, separators=(",", ":"))
+    print(f"merged {len(docs)} shard(s), "
+          f"{len(merged['traceEvents'])} events -> {out}")
+    _print_summary(merged)
+    return 0
+
+
+def _cmd_summary(args):
+    docs = [load_trace(p) for p in args.paths]
+    doc = docs[0] if len(docs) == 1 else merge_traces(docs)
+    _print_summary(doc)
+    return 0
+
+
+def _print_summary(doc):
+    s = summarize_trace(doc)
+    tracks = s.get("tracks", {})
+    if tracks:
+        width = max(len(n) for n in tracks)
+        print(f"{'track'.ljust(width)}  events     busy_ms  occupancy")
+        for name, tr in tracks.items():
+            print(f"{name.ljust(width)}  {tr['events']:6d}  "
+                  f"{tr['busy_ms']:10.3f}  {tr['occupancy']:9.4f}")
+    pipe = s.get("pipeline")
+    if pipe:
+        print("pipeline:")
+        print(f"  stages={pipe['stages']} "
+              f"dispatch_windows={pipe['dispatch_windows']} "
+              f"occupancy={pipe['occupancy']}")
+        line = f"  bubble_fraction={pipe['bubble_fraction']}"
+        if pipe.get("analytic_bubble_fraction") is not None:
+            line += (" (schedule analytic "
+                     f"{pipe['analytic_bubble_fraction']})")
+        print(line)
+        sched = pipe.get("schedule")
+        if sched:
+            print(f"  schedule: p={sched.get('stages')} "
+                  f"m={sched.get('micro_batches')} "
+                  f"v={sched.get('num_virtual_stages')} "
+                  f"ticks={sched.get('ticks')}")
+    if not tracks and not pipe:
+        print("no complete events in trace")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="ds_trace",
+        description="merge / summarize deepspeed-tpu Perfetto traces")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    m = sub.add_parser("merge", help="merge per-rank trace shards")
+    m.add_argument("paths", nargs="+")
+    m.add_argument("-o", "--output", default=None)
+    m.set_defaults(fn=_cmd_merge)
+    s = sub.add_parser("summary",
+                       help="per-track occupancy + pipeline bubble")
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=_cmd_summary)
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `ds_trace summary | head` closing stdout is not an error
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+cli_main = main
+
+if __name__ == "__main__":
+    sys.exit(main())

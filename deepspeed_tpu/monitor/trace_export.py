@@ -1,0 +1,323 @@
+"""Perfetto/Chrome trace-event export.
+
+Renders the monitor's forensic timeline into the Chrome trace-event
+JSON format (the `{"traceEvents": [...]}` object form) that opens
+directly in Perfetto (ui.perfetto.dev) or chrome://tracing:
+
+  * the fence-aligned host spans (forward/backward/step/ckpt) become
+    complete ("X") events on one track per span name — the StepTrace
+    feeds them through its export sink, so span timing is recorded
+    once and rendered everywhere;
+  * host subsystems (checkpoint writer commits, prefetch staging,
+    offload host steps) get their own tracks, stamped from the threads
+    that actually did the work;
+  * the pipeline timeline: the 1F1B / interleaved clock tables
+    (`runtime/pipe/schedule.py` via `interp.build_clock_tables`) are
+    the compiled executor's exact per-tick (stage, microbatch, chunk)
+    placement; each `train_batch` dispatch stamps them with its real
+    host dispatch window (`pipe/engine.py`), and the exporter lays the
+    ticks out uniformly across that window — one track per stage, one
+    "X" event per busy (tick, stage) carrying mb/chunk args, idle
+    ticks left empty so the fill/drain bubble is VISIBLE as white
+    space. The computed bubble fraction (1 - busy/(ticks*stages))
+    rides in the trace metadata next to the schedule's analytic
+    (p-1)/(v*m+p-1).
+
+Events use the documented trace-format keys: `name`, `ph`, `ts`
+(microseconds), `dur` ("X" only), `pid`, `tid`, `cat`, `args`.
+`pid` is the JAX process index (rank), so per-rank shards merge into
+one multi-rank timeline (`bin/ds_trace merge`). Track naming rides
+"M"/thread_name metadata events.
+
+The buffer is a bounded deque (`monitor.trace.max_events`): a run that
+traces forever retains the LAST window, which is exactly the forensic
+slice a post-mortem needs. `write(path)` is atomic
+(tmp + fsync + rename — the PR-3 writer discipline): a dump racing a
+reader or a kill never leaves a torn JSON.
+"""
+
+import collections
+import json
+import os
+import threading
+import time
+
+TRACE_SCHEMA_VERSION = 1
+
+# Perfetto renders these category colors distinctly; they also make
+# programmatic filtering (ds_trace summary) unambiguous.
+CAT_SPAN = "host_span"
+CAT_SUBSYSTEM = "subsystem"
+CAT_PIPE_FWD = "pipe_fwd"
+CAT_PIPE_BWD = "pipe_bwd"
+CAT_MARK = "mark"
+
+
+def analytic_bubble_fraction(stages, micro_batches, num_virtual_stages=1):
+    """The schedule's fill/drain bubble: (p-1)/(v*m+p-1) stage-time
+    units idle per stage (Megatron interleaved-1F1B formula; v=1 gives
+    plain 1F1B's (p-1)/(m+p-1))."""
+    p, m, v = stages, micro_batches, num_virtual_stages
+    return (p - 1) / float(v * m + p - 1)
+
+
+def tables_bubble_fraction(tables):
+    """Measured bubble of a clock-table set: the fraction of
+    (tick, stage) slots executing neither a forward nor a backward."""
+    fwd, bwd = tables["fwd_mb"], tables["bwd_mb"]
+    total = fwd.shape[0] * fwd.shape[1]
+    busy = int((fwd >= 0).sum() + (bwd >= 0).sum())
+    return 1.0 - busy / float(total)
+
+
+class TraceExporter:
+    """Bounded trace-event buffer with atomic JSON export.
+
+    Thread-safe: the checkpoint writer and prefetch worker stamp their
+    tracks from their own threads. Appends are deque ops under a lock;
+    nothing here touches the device.
+    """
+
+    def __init__(self, rank=0, max_events=200000, meta=None):
+        self.rank = int(rank)
+        self._events = collections.deque(maxlen=int(max_events))
+        self._lock = threading.Lock()
+        self._tracks = {}            # name -> tid
+        self._track_meta = []        # emitted thread_name records
+        self._meta = dict(meta or {})
+        self._pipeline = None        # bubble/occupancy metadata
+        self._t0 = time.perf_counter()
+        self._epoch = time.time() - self._t0   # perf_counter -> unix
+
+    # ------------------------------------------------------------------
+    # track + event primitives
+    # ------------------------------------------------------------------
+    def _tid(self, track):
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = self._tracks[track] = len(self._tracks)
+            self._track_meta.append({
+                "name": "thread_name", "ph": "M", "pid": self.rank,
+                "tid": tid, "args": {"name": track}})
+        return tid
+
+    def _us(self, t_perf):
+        # trace `ts` is microseconds; anchor on the unix clock so
+        # shards from different processes merge on one axis
+        return (t_perf + self._epoch) * 1e6
+
+    def complete(self, track, name, t_start, dur, cat=CAT_SPAN,
+                 args=None):
+        """One complete ("X") slice. `t_start` is a time.perf_counter()
+        stamp; `dur` seconds."""
+        with self._lock:
+            ev = {"name": name, "ph": "X", "cat": cat,
+                  "ts": round(self._us(t_start), 3),
+                  "dur": round(dur * 1e6, 3),
+                  "pid": self.rank, "tid": self._tid(track)}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    def instant(self, track, name, t_at=None, cat=CAT_MARK, args=None):
+        with self._lock:
+            ev = {"name": name, "ph": "i", "s": "t", "cat": cat,
+                  "ts": round(self._us(
+                      time.perf_counter() if t_at is None else t_at), 3),
+                  "pid": self.rank, "tid": self._tid(track)}
+            if args:
+                ev["args"] = args
+            self._events.append(ev)
+
+    def counter(self, track, name, values, t_at=None):
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "C",
+                "ts": round(self._us(
+                    time.perf_counter() if t_at is None else t_at), 3),
+                "pid": self.rank, "tid": self._tid(track),
+                "args": {k: float(v) for k, v in values.items()}})
+
+    # ------------------------------------------------------------------
+    # pipeline timeline
+    # ------------------------------------------------------------------
+    def add_pipeline_step(self, tables, meta, t_start, t_end, step=None):
+        """Lay one train_batch dispatch window out over the clock
+        tables: tick t of T occupies
+        [t_start + t*dt, t_start + (t+1)*dt), dt = (t_end-t_start)/T.
+        Real per-tick device time is not host-observable without a
+        fence; the uniform layout preserves exactly what the tables
+        guarantee — order, concurrency and the bubble — which is what
+        a bubble post-mortem needs.
+
+        `tables`: build_clock_tables output (numpy). `meta`:
+        {"stages", "micro_batches", "num_virtual_stages"}."""
+        fwd_mb, bwd_mb = tables["fwd_mb"], tables["bwd_mb"]
+        fwd_ch, bwd_ch = tables["fwd_chunk"], tables["bwd_chunk"]
+        T, S = fwd_mb.shape
+        dt = max((t_end - t_start), 1e-9) / T
+        s_args = None if step is None else {"step": int(step)}
+        for t in range(T):
+            ts = t_start + t * dt
+            for s in range(S):
+                if fwd_mb[t, s] >= 0:
+                    args = {"mb": int(fwd_mb[t, s]),
+                            "chunk": int(fwd_ch[t, s]), "tick": t}
+                    if s_args:
+                        args.update(s_args)
+                    self.complete(
+                        f"pipe/stage{s}",
+                        f"F mb{int(fwd_mb[t, s])} c{int(fwd_ch[t, s])}",
+                        ts, dt, cat=CAT_PIPE_FWD, args=args)
+                if bwd_mb[t, s] >= 0:
+                    args = {"mb": int(bwd_mb[t, s]),
+                            "chunk": int(bwd_ch[t, s]), "tick": t}
+                    if s_args:
+                        args.update(s_args)
+                    self.complete(
+                        f"pipe/stage{s}",
+                        f"B mb{int(bwd_mb[t, s])} c{int(bwd_ch[t, s])}",
+                        ts, dt, cat=CAT_PIPE_BWD, args=args)
+        if self._pipeline is None:
+            p = int(meta["stages"])
+            m = int(meta["micro_batches"])
+            v = int(meta.get("num_virtual_stages", 1))
+            self._pipeline = {
+                "stages": p, "micro_batches": m,
+                "num_virtual_stages": v, "ticks": int(T),
+                "bubble_fraction": round(tables_bubble_fraction(tables),
+                                         6),
+                "analytic_bubble_fraction": round(
+                    analytic_bubble_fraction(p, m, v), 6),
+            }
+
+    # ------------------------------------------------------------------
+    # export
+    # ------------------------------------------------------------------
+    def to_dict(self):
+        with self._lock:
+            events = self._track_meta + list(self._events)
+            other = {"schema": TRACE_SCHEMA_VERSION, "rank": self.rank,
+                     **self._meta}
+            if self._pipeline is not None:
+                other["pipeline"] = dict(self._pipeline)
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": other}
+
+    def write(self, path):
+        """Atomic dump: serialize to `<path>.tmp`, fsync, rename —
+        a concurrent reader or a kill mid-write never sees torn JSON."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return path
+
+
+# ----------------------------------------------------------------------
+# shard merge + summary (the bin/ds_trace CLI core)
+# ----------------------------------------------------------------------
+def load_trace(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, list):          # bare-array trace format
+        doc = {"traceEvents": doc, "otherData": {}}
+    return doc
+
+
+def merge_traces(docs):
+    """Merge per-rank trace shards into one document. Events already
+    carry their rank as `pid` and absolute unix-anchored `ts`, so the
+    merge is concatenation + a stable ts sort; per-rank otherData nests
+    under "ranks"."""
+    events = []
+    ranks = {}
+    pipeline = None
+    for doc in docs:
+        events.extend(doc.get("traceEvents", []))
+        other = doc.get("otherData", {}) or {}
+        ranks[str(other.get("rank", len(ranks)))] = other
+        pipeline = pipeline or other.get("pipeline")
+    events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
+    other = {"schema": TRACE_SCHEMA_VERSION, "merged_ranks": len(docs),
+             "ranks": ranks}
+    if pipeline:
+        other["pipeline"] = pipeline
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def summarize_trace(doc):
+    """Occupancy per track + pipeline bubble, computed FROM THE EVENTS
+    (not the metadata), so a merged/filtered trace still summarizes
+    honestly. Returns a JSON-able dict."""
+    tracks = {}      # (pid, tid) -> {"busy_us", "t0", "t1", "events"}
+    names = {}
+    pipe_busy = {}
+    for ev in doc.get("traceEvents", []):
+        ph = ev.get("ph")
+        if ph == "M" and ev.get("name") == "thread_name":
+            names[(ev.get("pid"), ev.get("tid"))] = \
+                ev.get("args", {}).get("name")
+            continue
+        if ph != "X":
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        tr = tracks.setdefault(
+            key, {"busy_us": 0.0, "t0": float("inf"), "t1": 0.0,
+                  "events": 0})
+        ts, dur = float(ev.get("ts", 0)), float(ev.get("dur", 0))
+        tr["busy_us"] += dur
+        tr["t0"] = min(tr["t0"], ts)
+        tr["t1"] = max(tr["t1"], ts + dur)
+        tr["events"] += 1
+        if ev.get("cat") in (CAT_PIPE_FWD, CAT_PIPE_BWD):
+            # group by dispatch window (the "step" arg every pipeline
+            # event carries): the gap BETWEEN train_batch dispatches is
+            # host time, not pipeline bubble — a global span would bill
+            # it to the schedule
+            win = (ev.get("pid"), (ev.get("args") or {}).get("step"))
+            pb = pipe_busy.setdefault(
+                win, {"busy": 0.0, "t0": float("inf"), "t1": 0.0,
+                      "stages": set()})
+            pb["busy"] += dur
+            pb["t0"] = min(pb["t0"], ts)
+            pb["t1"] = max(pb["t1"], ts + dur)
+            pb["stages"].add(key)
+    out = {"tracks": {}}
+    for key, tr in sorted(tracks.items()):
+        span = max(tr["t1"] - tr["t0"], 1e-9)
+        name = names.get(key) or f"pid{key[0]}/tid{key[1]}"
+        out["tracks"][name] = {
+            "events": tr["events"],
+            "busy_ms": round(tr["busy_us"] / 1e3, 3),
+            "span_ms": round(span / 1e3, 3),
+            "occupancy": round(tr["busy_us"] / span, 4),
+        }
+    if pipe_busy:
+        busy = wall = 0.0
+        stages = 0
+        for pb in pipe_busy.values():
+            stages = max(stages, len(pb["stages"]))
+            busy += pb["busy"]
+            wall += max(pb["t1"] - pb["t0"], 1e-9) * len(pb["stages"])
+        out["pipeline"] = {
+            "stages": stages,
+            "dispatch_windows": len(pipe_busy),
+            "busy_ms": round(busy / 1e3, 3),
+            "wall_stage_ms": round(wall / 1e3, 3),
+            "occupancy": round(busy / wall, 4),
+            "bubble_fraction": round(1.0 - busy / wall, 4),
+        }
+        analytic = (doc.get("otherData", {}) or {}).get("pipeline", {})
+        if analytic:
+            out["pipeline"]["analytic_bubble_fraction"] = \
+                analytic.get("analytic_bubble_fraction")
+            out["pipeline"]["schedule"] = {
+                k: analytic.get(k) for k in
+                ("stages", "micro_batches", "num_virtual_stages",
+                 "ticks")}
+    return out

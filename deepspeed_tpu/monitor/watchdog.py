@@ -38,6 +38,7 @@ class StallWatchdog:
         self._lock = threading.Lock()
         self._last_fence = None      # None = not armed yet
         self._heartbeats = {}
+        self._terminal = set()       # finished subsystems (not stalled)
         self._fired_for = None       # fence timestamp already reported
         self.stall_count = 0
         self._stop = threading.Event()
@@ -67,6 +68,17 @@ class StallWatchdog:
     def heartbeat(self, source):
         with self._lock:
             self._heartbeats[source] = time.monotonic()
+            # a fresh beat revives a previously-finished subsystem
+            # (e.g. a new prefetch loader reusing the name)
+            self._terminal.discard(source)
+
+    def mark_terminal(self, source):
+        """A subsystem finished CLEANLY (e.g. the prefetch worker after
+        its loader exhausted). Its heartbeat age stops counting toward
+        a stall verdict — a done worker going quiet is not a wedge —
+        but it stays listed as terminal in the diagnostic."""
+        with self._lock:
+            self._terminal.add(source)
 
     # ------------------------------------------------------------------
     # the watchdog loop
@@ -74,11 +86,14 @@ class StallWatchdog:
     def _diagnose(self, now, age):
         with self._lock:
             beats = dict(self._heartbeats)
+            terminal = set(self._terminal)
         return {
             "fence_age_sec": round(age, 3),
             "timeout_sec": self.timeout_sec,
             "heartbeat_age_sec": {
-                src: round(now - t, 3) for src, t in beats.items()},
+                src: round(now - t, 3) for src, t in beats.items()
+                if src not in terminal},
+            "terminal_subsystems": sorted(terminal),
         }
 
     def _probe_device(self):
@@ -114,10 +129,12 @@ class StallWatchdog:
                 self._fired_for = last
                 self.stall_count += 1
             diag = self._diagnose(now, age)
+            term = diag.get("terminal_subsystems") or []
             logger.warning(
                 f"STALL: no sync fence for {age:.1f}s "
                 f"(stall_timeout_sec={self.timeout_sec}); last subsystem "
-                f"heartbeats (sec ago): {diag['heartbeat_age_sec']}")
+                f"heartbeats (sec ago): {diag['heartbeat_age_sec']}"
+                + (f"; finished: {term}" if term else ""))
             if self._emit is not None:
                 try:
                     self._emit("stall", diag)

@@ -167,6 +167,52 @@ MONITOR_STALL_PROBE = "stall_probe"
 MONITOR_STALL_PROBE_DEFAULT = False
 MONITOR_ALL_RANKS = "all_ranks"
 MONITOR_ALL_RANKS_DEFAULT = False
+# MFU denominator override (FLOP/s per chip). 0 = auto: the chip's
+# nominal bf16 peak on real TPUs, None (no MFU) on CPU/virtual meshes.
+# Set it to make MFU / tokens_per_sec_per_chip meaningful on
+# CPU-virtual-mesh rehearsal runs, or to report against a measured
+# (rather than nominal) peak.
+MONITOR_PEAK_FLOPS_OVERRIDE = "peak_flops_override"
+MONITOR_PEAK_FLOPS_OVERRIDE_DEFAULT = 0.0
+
+# -- monitor.trace: Perfetto/Chrome trace-event export ----------------
+#   {"trace": {"enabled": true, "path": "", "max_events": 200000}}
+# path defaults to <output_path>/trace_rank<r>.json; the file is
+# written at monitor.close(), on a watchdog fire, and on demand via
+# engine.monitor.export_trace(). bin/ds_trace merges per-rank shards.
+MONITOR_TRACE = "trace"
+MONITOR_TRACE_ENABLED = "enabled"
+MONITOR_TRACE_ENABLED_DEFAULT = False
+MONITOR_TRACE_PATH = "path"
+MONITOR_TRACE_PATH_DEFAULT = ""
+MONITOR_TRACE_MAX_EVENTS = "max_events"
+MONITOR_TRACE_MAX_EVENTS_DEFAULT = 200000
+
+# -- monitor.flight: crash/stall flight recorder ----------------------
+#   {"flight": {"enabled": true, "capacity": 256, "path": ""}}
+# A bounded in-memory ring of the last `capacity` monitor events +
+# per-subsystem heartbeat ages, dumped atomically (tmp+fsync+rename)
+# to flight_<ts>.json on watchdog fire, uncaught train_batch
+# exception, SIGTERM, or abnormal interpreter exit. Enabled by default
+# whenever the monitor is on (the ring is a deque append per event).
+MONITOR_FLIGHT = "flight"
+MONITOR_FLIGHT_ENABLED = "enabled"
+MONITOR_FLIGHT_ENABLED_DEFAULT = True
+MONITOR_FLIGHT_CAPACITY = "capacity"
+MONITOR_FLIGHT_CAPACITY_DEFAULT = 256
+MONITOR_FLIGHT_PATH = "path"
+MONITOR_FLIGHT_PATH_DEFAULT = ""
+
+# -- monitor.numerics: device-side numerics health --------------------
+#   {"numerics": {"enabled": true}}
+# Opt-in per-layer accumulators computed INSIDE the jitted step
+# (grad-norm/abs-max/nonfinite per top-level param group, activation
+# abs-max/mean/nonfinite at layer boundaries for layer-exposing
+# models) and drained in the existing one-device_get-per-fence path —
+# zero new per-step host syncs (guard-tested).
+MONITOR_NUMERICS = "numerics"
+MONITOR_NUMERICS_ENABLED = "enabled"
+MONITOR_NUMERICS_ENABLED_DEFAULT = False
 
 #############################################
 # Progressive layer drop

@@ -169,6 +169,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
         self._config = DeepSpeedConfig(config_dict, mpu,
                                        world_size=self.dp_world_size)
+        # numerics health (monitor/numerics.py): resolved BEFORE the
+        # model so layer-exposing resolutions can tap boundaries into
+        # the loss they build
+        _mon_cfg = self._config.monitor_config
+        self._numerics_on = bool(_mon_cfg.enabled and
+                                 _mon_cfg.numerics_enabled)
+        # set by layer-exposing model resolutions (PipelineModule):
+        # same signature as _loss_fn but returns (loss, act_stats[L,3])
+        self._loss_and_health_fn = None
+        self._act_layer_names = None
         self._resolve_model(model, model_parameters)
 
         # ---- precision mode ----
@@ -229,6 +239,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._ckpt_writer = None
         self._pending_grads = None
         self._pending_loss = None
+        self._pending_acts = None
         self.losses = None
 
         if self.gradient_predivide_factor() != 1.0 or \
@@ -901,6 +912,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             f"zero_stage={self.zero_policy.stage}, "
             f"dtype={self.compute_dtype.__name__}, "
             f"mesh={dict(self.mesh.shape)}", ranks=[0])
+        if self._numerics_on:
+            # host-side labels for the numerics stat rows: grad groups
+            # from the encoded-layout template (the tree the jitted
+            # stats walk), activation boundaries from the resolver
+            from deepspeed_tpu.monitor import numerics as _num
+            self.monitor.set_numerics_labels(
+                grad=_num.group_paths(self._params_enc_template),
+                act=self._act_layer_names)
         self._initial_params = None   # don't pin the caller's copy
 
     def _count_model_params(self, tree):
@@ -913,22 +932,33 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # jitted step functions
     # ------------------------------------------------------------------
     def _scaled_loss_fn(self, params, batch, rng, loss_scale, keep_prob):
+        """Returns (scaled_loss, (raw_loss, act_stats)); act_stats is
+        None unless numerics health is on AND the model resolution
+        provided a boundary-tapping loss (`_loss_and_health_fn`)."""
         gas = self._jit_gas()
         rngs = {"dropout": rng, "params": rng}
         kwargs = {}
         if self.progressive_layer_drop is not None:
             kwargs["layer_keep_prob"] = keep_prob
-        loss = self._loss_fn(params, batch, rngs=rngs, deterministic=False,
-                             **kwargs)
-        return loss * (loss_scale / gas), loss
+        if self._numerics_on and self._loss_and_health_fn is not None:
+            loss, acts = self._loss_and_health_fn(
+                params, batch, rngs=rngs, deterministic=False, **kwargs)
+        else:
+            loss = self._loss_fn(params, batch, rngs=rngs,
+                                 deterministic=False, **kwargs)
+            acts = None
+        return loss * (loss_scale / gas), (loss, acts)
 
     def _micro_grad(self, params, batch, rng, loss_scale, keep_prob):
+        """(raw_loss, grads, act_stats) for one microbatch; act_stats
+        is None unless numerics activation tapping is active."""
         if self._use_shardmap_grads:
-            return self._micro_grad_shardmap(params, batch, rng,
-                                             loss_scale, keep_prob)
+            loss, grads = self._micro_grad_shardmap(params, batch, rng,
+                                                    loss_scale, keep_prob)
+            return loss, grads, None
         grad_fn = jax.value_and_grad(self._scaled_loss_fn, has_aux=True)
-        (_, raw_loss), grads = grad_fn(params, batch, rng, loss_scale,
-                                       keep_prob)
+        (_, (raw_loss, acts)), grads = grad_fn(params, batch, rng,
+                                               loss_scale, keep_prob)
         if not (self.bf16_sr_mode and self._jit_gas() == 1):
             # fp32 grads for accumulation / the fp32-master update. In
             # SR mode at gas=1 they stay in compute dtype: the update
@@ -942,7 +972,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         grads = self.zero_policy.encode(grads, self._zero_pad_plan)
         grads = jax.lax.with_sharding_constraint(
             grads, self._acc_shardings)
-        return raw_loss, grads
+        return raw_loss, grads, acts
 
     def _sparse_grad_paths(self):
         if not self.sparse_gradients_enabled():
@@ -973,8 +1003,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 rng, jax.lax.axis_index(DATA_AXIS))
             grad_fn = jax.value_and_grad(self._scaled_loss_fn,
                                          has_aux=True)
-            (_, raw_loss), grads = grad_fn(params, batch, rng,
-                                           loss_scale, kp)
+            # act stats are dropped on the CSR shard_map path (its
+            # out_specs predate numerics health; stage-0 sparse models
+            # still get grad-group stats from the update tail)
+            (_, (raw_loss, _acts)), grads = grad_fn(params, batch, rng,
+                                                    loss_scale, kp)
             tokens = int(np.prod(
                 jax.tree_util.tree_leaves(batch)[0].shape))
 
@@ -1008,7 +1041,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
 
     def _unscale_clip_and_update(self, state: EngineState, lr,
                                  grads=None, transform=None,
-                                 local_axis=None):
+                                 local_axis=None, with_health=True):
         """Tail of the step: unscale, overflow vote, clip, cond-update.
         `grads` (gas=1 fast path) bypasses the persistent accumulator.
         `transform` overrides self.optimizer_transform (1-bit Adam's
@@ -1030,8 +1063,23 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # consumers (norm + update), letting XLA materialize a full fp32
         # grad tree at peak in SR gas=1 mode
         clip = self.gradient_clipping()
+        if self._numerics_on and with_health:
+            # per-group numerics health on the UNSCALED grads (norm /
+            # absmax / nonfinite flag per top-level group — the
+            # overflow source). The per-leaf sum-of-squares pass is
+            # computed ONCE and shared with the global norm below, so
+            # with clipping/fp16 the accumulators add exactly one new
+            # reduction pass (absmax) per leaf to the jitted step
+            from deepspeed_tpu.monitor import numerics as _num
+            sq_tree = _num.leaf_sumsq(grads)
+            health_grad = _num.grad_group_stats(grads, sq_tree=sq_tree)
+        else:
+            sq_tree = None
+            health_grad = None
         if self.fp16_mode or (clip and clip > 0):
-            grad_norm = _global_norm(grads)
+            grad_norm = jnp.sqrt(jnp.sum(jnp.stack(
+                jax.tree_util.tree_leaves(sq_tree)))) \
+                if sq_tree is not None else _global_norm(grads)
         else:
             # nothing consumes the norm (no overflow vote off-fp16, no
             # clip): computing it anyway costs a full extra HBM read of
@@ -1135,7 +1183,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             skipped=state.skipped + overflow.astype(jnp.int32),
             global_steps=state.global_steps +
             (1 - overflow.astype(jnp.int32)))
-        return new_state, overflow, grad_norm
+        return new_state, overflow, grad_norm, health_grad
 
     def _resolve_step_lr(self, state, lr):
         """Inside-jit lr resolution: under async dispatch the host
@@ -1166,26 +1214,32 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def _scan_microbatches(self, micro_fn, acc0, stacked_batch, rng, gas,
                            force_scan=False):
         """Accumulate over the gas microbatches of a stacked [gas, ...]
-        batch. micro_fn(mb, rng) -> (loss, grads). Returns
-        (grads_or_acc, mean_loss). gas==1 skips the accumulator and the
+        batch. micro_fn(mb, rng) -> (loss, grads, act_stats). Returns
+        (grads_or_acc, mean_loss, act_stats) — act_stats ([L,3] device
+        numerics health, or None) reduced over microbatches
+        (max/mean/sum per column). gas==1 skips the accumulator and the
         per-microbatch rng fold (grads flow straight to the update)
         unless force_scan — the offload path always accumulates into
         its persistent buffer."""
         if gas == 1 and not force_scan:
             mb = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
-            loss, grads = micro_fn(mb, rng)
-            return grads, loss
+            loss, grads, acts = micro_fn(mb, rng)
+            return grads, loss, acts
 
         def body(carry, mb):
             acc, i = carry
-            loss, grads = micro_fn(mb, jax.random.fold_in(rng, i))
+            loss, grads, acts = micro_fn(mb, jax.random.fold_in(rng, i))
             acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-            return (acc, i + 1), loss
+            # acts=None is an empty pytree: scan stacks nothing
+            return (acc, i + 1), (loss, acts)
 
-        (acc, _), losses = jax.lax.scan(
+        (acc, _), (losses, acts) = jax.lax.scan(
             body, (acc0, jnp.asarray(0, jnp.int32)), stacked_batch,
             length=gas)
-        return acc, jnp.mean(losses)
+        if acts is not None:
+            from deepspeed_tpu.monitor import numerics as _num
+            acts = _num.combine_act_microbatches(acts)
+        return acc, jnp.mean(losses), acts
 
     def _build_step_fns(self):
         mesh = self.mesh
@@ -1231,10 +1285,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             def fused_grads_only(state, stacked_batch, rng, keep_prob):
                 micro = lambda mb, r: self._micro_grad(
                     state.params, mb, r, state.scale.loss_scale, keep_prob)
-                acc, loss = self._scan_microbatches(
+                acc, loss, acts = self._scan_microbatches(
                     micro, state.acc_grads, stacked_batch, rng, gas,
                     force_scan=True)
-                return state._replace(acc_grads=acc), loss
+                return state._replace(acc_grads=acc), loss, acts
 
             self._offload_grads_jit = jax.jit(fused_grads_only,
                                               donate_argnums=(0,))
@@ -1244,17 +1298,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             lr = self._resolve_step_lr(state, lr)
             micro = lambda mb, r: self._micro_grad(
                 state.params, mb, r, state.scale.loss_scale, keep_prob)
-            out, loss = self._scan_microbatches(
+            out, loss, acts = self._scan_microbatches(
                 micro, state.acc_grads, stacked_batch, rng, gas)
             if gas == 1:
                 # no accumulator: grads flow straight into the update
-                new_state, overflow, grad_norm = \
+                new_state, overflow, grad_norm, hgrad = \
                     self._unscale_clip_and_update(state, lr, grads=out)
             else:
                 state = state._replace(acc_grads=out)
-                new_state, overflow, grad_norm = \
+                new_state, overflow, grad_norm, hgrad = \
                     self._unscale_clip_and_update(state, lr)
-            return new_state, loss, overflow, grad_norm
+            health = {"grad": hgrad, "act": acts} \
+                if self._numerics_on else None
+            return new_state, loss, overflow, grad_norm, health
 
         self._fused_step_jit = jax.jit(fused_train_step,
                                        donate_argnums=(0,))
@@ -1299,20 +1355,24 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     mb_rng, jax.lax.axis_index(DATA_AXIS))
                 grad_fn = jax.value_and_grad(self._scaled_loss_fn,
                                              has_aux=True)
-                (_, raw_loss), grads = grad_fn(
+                (_, (raw_loss, _acts)), grads = grad_fn(
                     state.params, mb, mb_rng, state.scale.loss_scale,
                     keep_prob)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
-                return jax.lax.pmean(raw_loss, DATA_AXIS), grads
+                # numerics health is dropped on the compressed 1-bit
+                # path (its shard_map out_specs predate it)
+                return jax.lax.pmean(raw_loss, DATA_AXIS), grads, None
 
-            grads, loss = self._scan_microbatches(
+            grads, loss, _acts = self._scan_microbatches(
                 micro, _zeros_like_f32(state.params), stacked_batch,
                 rng, gas)
-            new_state, overflow, grad_norm = \
+            # with_health=False: nothing consumes health here — don't
+            # even trace the stat reductions on the compressed path
+            new_state, overflow, grad_norm, _hgrad = \
                 self._unscale_clip_and_update(
                     state, lr, grads=grads, transform=transform,
-                    local_axis=DATA_AXIS)
+                    local_axis=DATA_AXIS, with_health=False)
             return new_state, loss, overflow, grad_norm
 
         P = PartitionSpec
@@ -1332,12 +1392,15 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         def compressed_step(state, stacked_batch, rng, lr, keep_prob):
             batch_specs = stacked_batch_pspecs(stacked_batch)
             st_specs = state_specs(state)
-            return shard_map(
+            new_state, loss, overflow, grad_norm = shard_map(
                 local_step, mesh=mesh,
                 in_specs=(st_specs, batch_specs, P(), P(), P()),
                 out_specs=(st_specs, P(), P(), P()),
                 check_vma=False)(state, stacked_batch, rng, lr,
                                  keep_prob)
+            # arity parity with _fused_step_jit (no numerics health on
+            # the compressed path)
+            return new_state, loss, overflow, grad_norm, None
 
         self._onebit_compressed_jit = jax.jit(compressed_step,
                                               donate_argnums=(0,))
@@ -1397,9 +1460,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                                jnp.float32)
         return self._keep_prob_one
 
+    def _spans_active(self):
+        """Record fwd/bwd/step spans when wall_clock_breakdown is on OR
+        a Perfetto trace is being exported (monitor.trace.enabled) —
+        the exporter renders the same fence-free spans as slices."""
+        return self.wall_clock_breakdown() or \
+            self.monitor.trace_export is not None
+
     def forward(self, batch, **kwargs):
         """Compute loss (and cache grads for `backward`)."""
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             # fence-free span (monitor/trace.py): host dispatch time +
             # profiler TraceAnnotation, reported at sync fences — the
             # legacy path barriered the device TWICE per microstep here
@@ -1416,12 +1486,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             if jax.tree_util.tree_leaves(batch) else ()
         self._tokens_per_sample = int(np.prod(lead[1:])) \
             if len(lead) > 1 else 1
-        loss, grads = self._micro_grad_jit(
+        loss, grads, acts = self._micro_grad_jit(
             self.state.params, batch, self._next_rng(),
             self.state.scale.loss_scale, self._keep_prob())
         self._pending_grads = grads
         self._pending_loss = loss
-        if self.wall_clock_breakdown():
+        # numerics health, manual path: the LAST microbatch's boundary
+        # stats stand in for the accumulation window (device array, no
+        # sync; folded at the model step)
+        self._pending_acts = acts
+        if self._spans_active():
             self.monitor.trace.stop(SPAN_FORWARD)
         return loss
 
@@ -1436,7 +1510,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         use it when the loop never reads `engine.losses`."""
         assert self._pending_grads is not None, \
             "backward() called without a preceding forward()"
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.start(SPAN_BACKWARD)
         if not jax.tree_util.tree_leaves(self.state.acc_grads):
             # gas=1 fast path keeps no persistent accumulator; the first
@@ -1452,7 +1526,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.losses = None
         else:
             self.losses = loss if loss is not None else self._pending_loss
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.stop(SPAN_BACKWARD)
         return loss
 
@@ -1465,13 +1539,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def step(self, lr_kwargs=None):
         """Advance one micro step; at the grad-accum boundary, apply the
         model step (ref engine.py:955-1078)."""
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.start(SPAN_STEP)
         if self.is_gradient_accumulation_boundary():
             self._take_model_step(lr_kwargs)
         self.micro_steps += 1
         self._release_pending_loss()
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.stop(SPAN_STEP)
 
     def _take_model_step(self, lr_kwargs=None):
@@ -1482,11 +1556,17 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             overflow = self._offload_take_step(lr)
             self._host_steps += 1
             if self.monitor.enabled:
+                health = None
+                if self._numerics_on:
+                    health = {"grad": None,
+                              "act": getattr(self, "_pending_acts",
+                                             None)}
+                    self._pending_acts = None
                 self.monitor.on_step(
                     loss=self.losses, grad_norm=self._offload_last_norm,
                     loss_scale=self._host_scaler.cur_scale,
                     overflow=overflow, tokens=tokens,
-                    wire_stats=self.wire_stats)
+                    wire_stats=self.wire_stats, health=health)
             self._after_model_step(jnp.asarray(overflow))
             return
         if self._use_onebit_shardmap and not self._onebit_warned_manual \
@@ -1499,13 +1579,19 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 "the compressed phase; use train_batch() to get the "
                 "bit-packed collective past freeze_step")
             self._onebit_warned_manual = True
-        self.state, overflow, grad_norm = self._apply_jit(self.state, lr)
+        self.state, overflow, grad_norm, hgrad = \
+            self._apply_jit(self.state, lr)
         self._host_steps += 1
         if self.monitor.enabled:
+            health = None
+            if self._numerics_on:
+                health = {"grad": hgrad,
+                          "act": getattr(self, "_pending_acts", None)}
+                self._pending_acts = None
             self.monitor.on_step(
                 loss=self.losses, grad_norm=grad_norm,
                 loss_scale=self.state.scale.loss_scale,
-                overflow=overflow, tokens=tokens)
+                overflow=overflow, tokens=tokens, health=health)
         self._after_model_step(overflow)
 
     def _next_lr(self):
@@ -1634,12 +1720,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         `depth` (default async_dispatch.prefetch_depth) staged batches
         ahead of the step loop. Feed the result to `train_batch` as
         `data_iter`."""
+        mon = self.monitor
         loader = PrefetchLoader(
             data_source, stage_fn=self.stage_batch, gas=self._jit_gas(),
             depth=depth if depth is not None else self.prefetch_depth(),
             stacked=stacked,
-            heartbeat=(lambda: self.monitor.heartbeat("prefetch"))
-            if self.monitor.enabled else None)
+            heartbeat=(lambda: mon.heartbeat("prefetch"))
+            if mon.enabled else None,
+            finished=(lambda: mon.heartbeat_done("prefetch"))
+            if mon.enabled else None,
+            span=(lambda t0, dur: mon.subsystem_span(
+                "prefetch", "stage_batch", t0, dur))
+            if mon.trace_export is not None else None)
         # queue-occupancy gauge + stall-diagnosis heartbeats ride the
         # live loader
         self.monitor.attach_prefetch(loader)
@@ -1649,7 +1741,28 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         """Fast path: one fused jitted step over all grad-accum
         microbatches. Pass an iterator yielding microbatches, a
         PrefetchLoader (pre-staged batches, no host collate here), or a
-        pre-stacked batch pytree with leading dim [gas, micro_bs, ...]."""
+        pre-stacked batch pytree with leading dim [gas, micro_bs, ...].
+
+        An exception escaping the step loop is a forensic moment: the
+        flight recorder (monitor/flight.py) dumps the last events +
+        heartbeat ages before it propagates (StopIteration — a merely
+        exhausted data iterator — is not a crash)."""
+        try:
+            return self._train_batch_impl(data_iter=data_iter,
+                                          batch=batch)
+        except StopIteration:
+            raise
+        except BaseException as e:
+            if self.monitor.enabled and \
+                    not getattr(e, "_ds_flight_dumped", False):
+                try:
+                    e._ds_flight_dumped = True
+                except Exception:
+                    pass
+                self.monitor.on_crash(e)
+            raise
+
+    def _train_batch_impl(self, data_iter=None, batch=None):
         gas = self._jit_gas()
         if batch is None:
             assert data_iter is not None
@@ -1683,13 +1796,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self.flops_profiler_enabled() and \
                 self._host_steps + 1 == self.flops_profiler_profile_step():
             self._profile_fused_step(batch, lr)
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.start(SPAN_STEP)
+        health = None
         if self._offload_enabled():
-            self.state, loss = self._offload_grads_jit(
+            self.state, loss, acts = self._offload_grads_jit(
                 self.state, batch, self._next_rng(), self._keep_prob())
             overflow = jnp.asarray(self._offload_take_step(lr))
             grad_norm = None
+            if self._numerics_on:
+                health = {"grad": None, "act": acts}
         else:
             step_fn = self._fused_step_jit
             if self._use_onebit_shardmap:
@@ -1714,9 +1830,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         ranks=[0])
                 if self._onebit_compressed_active:
                     step_fn = self._onebit_compressed_jit
-            self.state, loss, overflow, grad_norm = step_fn(
+            self.state, loss, overflow, grad_norm, health = step_fn(
                 self.state, batch, self._next_rng(), lr, self._keep_prob())
-        if self.wall_clock_breakdown():
+        if self._spans_active():
             self.monitor.trace.stop(SPAN_STEP)
         mbs = self._microbatches_per_step()
         self.micro_steps += mbs
@@ -1729,12 +1845,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     loss=loss, grad_norm=self._offload_last_norm,
                     loss_scale=self._host_scaler.cur_scale,
                     overflow=overflow, tokens=tokens,
-                    wire_stats=self.wire_stats)
+                    wire_stats=self.wire_stats, health=health)
             else:
                 self.monitor.on_step(
                     loss=loss, grad_norm=grad_norm,
                     loss_scale=self.state.scale.loss_scale,
-                    overflow=overflow, tokens=tokens)
+                    overflow=overflow, tokens=tokens, health=health)
         self._after_model_step(overflow)
         # one fused step consumed `mbs` microbatches worth of samples
         self.tput_timer.stop(count=mbs)

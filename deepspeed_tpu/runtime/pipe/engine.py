@@ -236,13 +236,14 @@ class PipelineEngine(DeepSpeedEngine):
             kp_accepting = _layers_accepting(model, "layer_keep_prob")
             self._pld_accepting_layers = kp_accepting
 
-            def chained_loss(params, batch, rngs=None, deterministic=False,
-                             layer_keep_prob=None, **_):
+            def _chained(params, batch, rngs, deterministic,
+                         layer_keep_prob, collect):
                 if getattr(self, "_pipe_flat_mode", False) and \
                         isinstance(params, dict) and "flat" in params:
                     params = self._pipe_layout.unflatten(params)
                 inputs, labels = _split_batch(batch)
                 x = inputs
+                stats = [] if collect else None
                 for idx in range(len(model.layers)):
                     kw = {}
                     if idx in det_accepting:
@@ -256,11 +257,37 @@ class PipelineEngine(DeepSpeedEngine):
                     x = model.apply_layer(
                         idx, model.layer_params(params, idx), x, rngs=rngs,
                         **kw)
+                    if collect:
+                        # numerics health: boundary stats AFTER layer
+                        # idx — a finite input with a nonfinite output
+                        # names the first-NaN layer
+                        from deepspeed_tpu.monitor import numerics as nm
+                        stats.append(nm.tensor_stats(x))
                 if model.loss_fn is not None:
-                    return model.loss_fn(x, labels)
+                    x = model.loss_fn(x, labels)
+                if collect:
+                    from deepspeed_tpu.monitor import numerics as nm
+                    return x, nm.stack_act_stats(stats)
                 return x
 
+            def chained_loss(params, batch, rngs=None,
+                             deterministic=False, layer_keep_prob=None,
+                             **_):
+                return _chained(params, batch, rngs, deterministic,
+                                layer_keep_prob, collect=False)
+
+            def chained_loss_health(params, batch, rngs=None,
+                                    deterministic=False,
+                                    layer_keep_prob=None, **_):
+                return _chained(params, batch, rngs, deterministic,
+                                layer_keep_prob, collect=True)
+
             self._loss_fn = chained_loss
+            if self._numerics_on:
+                self._loss_and_health_fn = chained_loss_health
+                self._act_layer_names = [
+                    f"layer{idx}:{type(layer).__name__}"
+                    for idx, layer in enumerate(model.layers)]
             self._initial_params = model_parameters
             return
 
@@ -314,9 +341,11 @@ class PipelineEngine(DeepSpeedEngine):
             # join the padded layout when ZeRO pads odd leaves (same as
             # _micro_grad's exit path)
             grads = self.zero_policy.encode(grads, self._zero_pad_plan)
-            new_state, overflow, grad_norm = \
+            new_state, overflow, grad_norm, hgrad = \
                 self._unscale_clip_and_update(state, lr, grads=grads)
-            return new_state, loss, overflow, grad_norm
+            health = {"grad": hgrad, "act": None} \
+                if self._numerics_on else None
+            return new_state, loss, overflow, grad_norm, health
 
         # the base train_batch dispatches whatever _fused_step_jit is;
         # the 1F1B program replaces the sequential-chain scan
@@ -425,11 +454,14 @@ class PipelineEngine(DeepSpeedEngine):
                     [np.asarray(x) for x in xs]), *micro)
         return batch
 
-    def train_batch(self, data_iter=None, batch=None):
+    def _train_batch_impl(self, data_iter=None, batch=None):
         """SPMD path: the microbatch axis folds *inside* the compiled
         loss, so the step sees one [1, full_batch, ...] stack.
         Sequential path: the full batch splits into [gas, micro_bs, ...]
-        and the base engine's fused scan provides the microbatch loop."""
+        and the base engine's fused scan provides the microbatch loop.
+        (The public train_batch is the base class's crash-guarded
+        wrapper — an exception anywhere in here still dumps the flight
+        recorder.)"""
         m = self.micro_batches
         batch = self._collect_full_batch(data_iter, batch)
         if self._pipelined_protocol:
@@ -443,7 +475,22 @@ class PipelineEngine(DeepSpeedEngine):
             if getattr(self, "_use_1f1b", False):
                 stacked = _to_dict_batch(stacked)
                 self._ensure_interp(stacked)
-        return super().train_batch(batch=stacked)
+        te = self.monitor.trace_export
+        if te is not None and getattr(self, "_use_1f1b", False) and \
+                self._interp_fn is not None:
+            # per-microbatch pipeline timeline: the compiled schedule's
+            # clock tables laid over this dispatch's REAL host wall
+            # window (under async dispatch: enqueue time — the tick
+            # layout, concurrency and bubble come from the tables, the
+            # absolute placement from the host clock)
+            import time as _time
+            t0 = _time.perf_counter()
+            loss = super()._train_batch_impl(batch=stacked)
+            te.add_pipeline_step(
+                self._interp_fn.clock_tables, self._interp_fn.pipe_meta,
+                t0, _time.perf_counter(), step=self._host_steps)
+            return loss
+        return super()._train_batch_impl(batch=stacked)
 
     def eval_batch(self, data_iter=None, batch=None):
         # the SPMD pipelined loss consumes a full batch of micro_batches

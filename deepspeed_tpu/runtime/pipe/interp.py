@@ -353,6 +353,10 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
     n_chunks = S * v
     tables = dict(build_clock_tables(m, S, train=train,
                                      num_virtual_stages=v))
+    # kept (numpy) for the trace exporter: the compiled program's
+    # EXACT per-tick (stage, microbatch, chunk) placement, stamped
+    # with host dispatch windows by pipe/engine.py
+    export_tables = dict(tables)
     C = int(tables.pop("channel_depth"))
     B = num_pipe_buffers(m, S, v) if train else 2 * v
     parts = list(module.parts) if chunk_parts is None else \
@@ -734,4 +738,9 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
             out_specs=(P(), grads_out_spec) if train else P(),
             check_vma=False)(params, stacked_batch, rng, loss_scale)
 
+    # forensics: the schedule this program executes (trace_export lays
+    # these ticks over each dispatch's wall window)
+    step.clock_tables = export_tables
+    step.pipe_meta = {"stages": S, "micro_batches": m,
+                      "num_virtual_stages": v, "train": train}
     return step

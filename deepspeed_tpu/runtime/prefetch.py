@@ -52,15 +52,25 @@ class PrefetchLoader:
       heartbeat: optional zero-arg callable invoked after each staged
         batch (the monitor's stall-watchdog heartbeat — a quiet
         prefetch worker shows up by age in the stall diagnostic).
+      finished: optional zero-arg callable invoked once when the worker
+        exits (source exhausted, error, or close). The monitor marks
+        the heartbeat TERMINAL there: a cleanly-finished worker's
+        growing heartbeat age must not read as a stall.
+      span: optional callable (t_start, dur_sec) per staged batch — the
+        Perfetto "prefetch" track stamp (collate + device staging time
+        on the worker thread).
     """
 
     def __init__(self, source, stage_fn=None, gas=1, depth=2,
-                 stacked=False, heartbeat=None):
+                 stacked=False, heartbeat=None, finished=None,
+                 span=None):
         self._source = source
         self._stage_fn = stage_fn
         self._gas = max(1, int(gas))
         self._stacked = stacked
         self._heartbeat = heartbeat
+        self._finished = finished
+        self._span = span
         self.depth = max(1, int(depth))
         self._queue = queue.Queue(maxsize=self.depth)
         self._exc = None
@@ -83,15 +93,22 @@ class PrefetchLoader:
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *micro)
 
     def _worker(self):
+        import time
         try:
             it = iter(self._source)
             while not self._closed:
+                t0 = time.perf_counter()
                 try:
                     batch = self._next_stacked(it)
                 except StopIteration:
                     break
                 if self._stage_fn is not None:
                     batch = self._stage_fn(batch)
+                if self._span is not None:
+                    try:
+                        self._span(t0, time.perf_counter() - t0)
+                    except Exception:
+                        pass
                 self._put(batch)
                 if self._heartbeat is not None:
                     try:
@@ -102,6 +119,14 @@ class PrefetchLoader:
             self._exc = e
         finally:
             self._put(_DONE)
+            if self._finished is not None:
+                # the worker is DONE (exhausted/closed/errored): its
+                # heartbeat goes terminal — the watchdog must not count
+                # a finished subsystem's age toward a stall verdict
+                try:
+                    self._finished()
+                except Exception:
+                    pass
 
     def _put(self, item):
         # bounded put that aborts when the consumer closes mid-wait

@@ -404,6 +404,8 @@ class ZeroOffloadMixin:
 
     def _offload_take_step(self, lr):
         """Host half: fetch clipped grads, CPU-Adam, push params."""
+        import time as _time
+        _t0 = _time.perf_counter()
         B = self._OFFLOAD_WIRE_BLOCK
         # warmup only means something for legs that compress; with a
         # fully native wire (32/32) wire_stats must not claim a warmup
@@ -449,6 +451,9 @@ class ZeroOffloadMixin:
                 scale=new_scale,
                 acc_grads=self._zero_acc(),
                 skipped=self.state.skipped + 1)
+            self.monitor.subsystem_span(
+                "offload", "host_step (overflow skip)", _t0,
+                _time.perf_counter() - _t0)
             return True
         if new_res is not None:
             self._offload_grad_residual = new_res
@@ -565,4 +570,10 @@ class ZeroOffloadMixin:
             scale=new_scale,
             acc_grads=self._zero_acc(),
             global_steps=self.state.global_steps + 1)
+        # the one host-synchronous engine path gets its own Perfetto
+        # track: D2H + chunked CPU-Adam + H2D as a single slice
+        self.monitor.subsystem_span(
+            "offload", "host_step", _t0, _time.perf_counter() - _t0,
+            args={"d2h_bytes": int(d2h_bytes),
+                  "h2d_bytes": int(h2d_bytes)})
         return False

@@ -29,7 +29,8 @@ setup(
     description="TPU-native training framework with DeepSpeed's "
                 "capabilities (JAX/XLA/Pallas)",
     packages=find_packages(include=["deepspeed_tpu*", "op_builder*"]),
-    scripts=["bin/dstpu", "bin/ds_report", "bin/ds_elastic"],
+    scripts=["bin/dstpu", "bin/ds_report", "bin/ds_elastic",
+             "bin/ds_trace"],
     install_requires=["jax", "flax", "optax", "numpy"],
     python_requires=">=3.10",
 )

@@ -1,0 +1,333 @@
+"""Flight recorder (ISSUE 7 tentpole b).
+
+Acceptance subprocess runs: a training loop that STALLS (watchdog
+fire) and one that RAISES (uncaught train_batch exception) — plus a
+SIGTERM'd run — each leave an atomic `flight_<ts>.json` containing the
+last monitor events, per-subsystem heartbeat ages, and (for an
+injected per-layer NaN) the correct first-NaN layer attribution.
+Plus in-process unit coverage: bounded ring, atomic dump format,
+terminal-heartbeat handling, crash-path dump from train_batch.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.flight import (FLIGHT_SCHEMA_VERSION,
+                                          FlightRecorder,
+                                          list_flight_dumps)
+from simple_model import SimpleModel
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# unit behavior
+# ----------------------------------------------------------------------
+def test_ring_is_bounded_and_dump_is_atomic(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path), capacity=5, rank=1,
+                         step_fn=lambda: 42,
+                         heartbeats_fn=lambda: ({"prefetch": 1.5},
+                                                ["ckpt"]))
+    for i in range(20):
+        rec.record({"kind": "metrics", "step": i})
+    rec.set_context(numerics={"first_nonfinite": None})
+    path = rec.dump("test", extra={"why": "unit"})
+    assert path and os.path.exists(path)
+    assert not [n for n in os.listdir(tmp_path) if ".tmp" in n]
+    doc = json.load(open(path))
+    assert doc["v"] == FLIGHT_SCHEMA_VERSION
+    assert doc["reason"] == "test" and doc["rank"] == 1
+    assert doc["step"] == 42
+    assert len(doc["events"]) == 5                    # bounded ring
+    assert [e["step"] for e in doc["events"]] == list(range(15, 20))
+    assert doc["heartbeat_age_sec"] == {"prefetch": 1.5}
+    assert doc["terminal_subsystems"] == ["ckpt"]
+    assert doc["extra"] == {"why": "unit"}
+    assert "numerics" in doc["context"]
+    assert list_flight_dumps(str(tmp_path)) == [path]
+    rec.disarm()
+
+
+def test_dump_survives_unwritable_dir():
+    rec = FlightRecorder(out_dir="/proc/definitely/not/writable")
+    rec.record({"kind": "metrics"})
+    assert rec.dump("test") is None     # swallowed, never raises
+    rec.disarm()
+
+
+# ----------------------------------------------------------------------
+# in-process engine wiring
+# ----------------------------------------------------------------------
+def _mk_batch(seed, bs=16, dim=8):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(bs, dim).astype(np.float32)
+    return {"x": x[None], "y": (x * 0.5)[None]}
+
+
+def test_train_batch_exception_dumps_flight(tmp_path):
+    model = SimpleModel(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={"train_batch_size": 16, "steps_per_print": 10000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "async_dispatch": {"enabled": True, "steps_per_sync": 1},
+                "monitor": {"enabled": True, "sinks": [],
+                            "output_path": str(tmp_path)}})
+    for i in range(3):
+        engine.train_batch(batch=_mk_batch(i))
+    with pytest.raises(AssertionError):
+        # stacked leading dim != gas -> the step-loop assertion fires
+        bad = {k: np.concatenate([v, v]) for k, v in
+               _mk_batch(99).items()}
+        engine.train_batch(batch=bad)
+    dumps = list_flight_dumps(str(tmp_path))
+    assert dumps, "no flight dump after an uncaught exception"
+    doc = json.load(open(dumps[-1]))
+    assert doc["reason"] == "exception"
+    assert doc["step"] == 3
+    kinds = [e.get("kind") for e in doc["events"]]
+    assert "crash" in kinds and "metrics" in kinds
+    crash = [e for e in doc["events"] if e.get("kind") == "crash"][-1]
+    assert "AssertionError" in crash["error"]
+    engine.monitor.close()
+
+
+def test_clean_close_disarms_and_double_crash_dumps_once(tmp_path):
+    model = SimpleModel(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={"train_batch_size": 16, "steps_per_print": 10000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "monitor": {"enabled": True, "sinks": [],
+                            "output_path": str(tmp_path)}})
+    engine.train_batch(batch=_mk_batch(0))
+    assert engine.monitor.flight.armed
+    engine.monitor.close()
+    assert not engine.monitor.flight.armed
+
+
+def test_finished_prefetch_goes_terminal_not_stalled(tmp_path):
+    """ISSUE 7 satellite: after the loader exhausts, the prefetch
+    worker exits cleanly — its heartbeat must go TERMINAL (excluded
+    from the stall verdict's age table) instead of aging forever."""
+    model = SimpleModel(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={"train_batch_size": 16, "steps_per_print": 10000,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "monitor": {"enabled": True, "sinks": [],
+                            "output_path": str(tmp_path),
+                            "stall_timeout_sec": 30}})
+    micro = [{k: v[0] for k, v in _mk_batch(i).items()}
+             for i in range(4)]
+    loader = engine.prefetch(iter(micro))
+    for _ in range(4):
+        engine.train_batch(data_iter=loader)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        ages, terminal = engine.monitor._heartbeat_state()
+        if "prefetch" in terminal:
+            break
+        time.sleep(0.05)
+    ages, terminal = engine.monitor._heartbeat_state()
+    assert "prefetch" in terminal
+    assert "prefetch" not in ages
+    diag = engine.monitor.watchdog._diagnose(time.monotonic(), 1.0)
+    assert "prefetch" not in diag["heartbeat_age_sec"]
+    assert "prefetch" in diag["terminal_subsystems"]
+    # a NEW loader revives the subsystem
+    loader2 = engine.prefetch(iter(micro))
+    engine.train_batch(data_iter=loader2)
+    ages, terminal = engine.monitor._heartbeat_state()
+    assert "prefetch" in ages and "prefetch" not in terminal
+    loader.close()
+    loader2.close()
+    engine.monitor.close()
+
+
+# ----------------------------------------------------------------------
+# subprocess acceptance runs
+# ----------------------------------------------------------------------
+_CHILD_PRELUDE = r"""
+import os, sys, json
+import numpy as np
+import jax
+jax.config.update('jax_platforms', 'cpu')
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, os.path.join({repo!r}, 'tests'))
+import deepspeed_tpu
+from simple_model import SimpleModel
+
+def mk(seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(16, 8).astype(np.float32)
+    return {{"x": x[None], "y": (x * 0.5)[None]}}
+
+def engine(outdir, **mon):
+    model = SimpleModel(hidden_dim=8)
+    cfg = {{"train_batch_size": 16, "steps_per_print": 10000,
+           "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+           "async_dispatch": {{"enabled": True, "steps_per_sync": 1}},
+           "monitor": dict({{"enabled": True, "sinks": ["jsonl"],
+                            "output_path": outdir}}, **mon)}}
+    e, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params, config=cfg)
+    return e
+"""
+
+
+def _run_child(body, tmp_path, timeout=240, expect_rc=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    script = _CHILD_PRELUDE.format(repo=REPO) + body
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+    if expect_rc is not None:
+        assert proc.returncode == expect_rc, \
+            (proc.returncode, proc.stderr[-2000:])
+    return proc
+
+
+def test_subprocess_stall_leaves_flight_dump(tmp_path):
+    """A run that stops stepping trips the watchdog; the process is
+    killed while stalled — the flight dump left behind explains its
+    final seconds (last events + heartbeat ages)."""
+    out = str(tmp_path / "mon")
+    body = f"""
+e = engine({out!r}, stall_timeout_sec=0.6)
+e.monitor.watchdog._poll = 0.05
+micro = [{{k: v[0] for k, v in mk(i).items()}} for i in range(4)]
+loader = e.prefetch(iter(micro))
+for i in range(4):
+    e.train_batch(data_iter=loader)
+import time
+time.sleep(3.0)        # mid-training stall: the loop stops stepping
+os._exit(7)            # die WITHOUT cleanup, like a wedged run killed
+"""
+    _run_child(body, tmp_path, expect_rc=7)
+    dumps = list_flight_dumps(out)
+    assert dumps, "stalled subprocess left no flight dump"
+    doc = json.load(open(dumps[-1]))
+    assert doc["reason"] == "stall"
+    assert doc["step"] == 4
+    assert doc["extra"]["fence_age_sec"] >= 0.6
+    kinds = [e.get("kind") for e in doc["events"]]
+    assert "metrics" in kinds and "stall" in kinds
+    # the finished prefetch worker reads as terminal, not as the stall
+    assert "prefetch" in doc["terminal_subsystems"]
+    assert "prefetch" not in doc["heartbeat_age_sec"]
+    # the stall event itself also reached the JSONL sink
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "events.jsonl"))]
+    assert any(ev["kind"] == "stall" for ev in events)
+
+
+def test_subprocess_raise_with_nan_injection_attributes_layer(tmp_path):
+    """A run that raises mid-training dumps the flight ring — and with
+    monitor.numerics on and a NaN-producing layer injected, the dump's
+    context names the first-NaN layer (the acceptance criterion)."""
+    out = str(tmp_path / "mon")
+    body = f"""
+import jax.numpy as jnp
+import flax.linen as nn
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+def bad(x):
+    # NaN injection: finite input, nonfinite output
+    return x + jnp.log(-jnp.ones_like(x))
+
+layers = [LayerSpec(nn.Dense, 16), jnp.tanh, bad, LayerSpec(nn.Dense, 8)]
+module = PipelineModule(layers, num_stages=1,
+                        loss_fn=lambda y, lab: jnp.mean(
+                            (y - lab[..., :8]) ** 2))
+params = module.init_params(jax.random.PRNGKey(0),
+                            jnp.zeros((16, 8), jnp.float32))
+cfg = {{"train_batch_size": 16, "steps_per_print": 10000,
+       "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+       "async_dispatch": {{"enabled": True, "steps_per_sync": 1}},
+       "mesh": {{"pipe": 1, "data": 1, "model": 1}},
+       "monitor": {{"enabled": True, "sinks": ["jsonl"],
+                   "output_path": {out!r},
+                   "numerics": {{"enabled": True}}}}}}
+e, _, _, _ = deepspeed_tpu.initialize(model=module,
+                                      model_parameters=params,
+                                      config=cfg)
+for i in range(3):
+    e.train_batch(batch=mk(i))
+e.train_batch(batch="not a batch")   # mid-training crash
+"""
+    proc = _run_child(body, tmp_path)
+    assert proc.returncode != 0
+    dumps = list_flight_dumps(out)
+    assert dumps, "raising subprocess left no flight dump"
+    docs = [json.load(open(p)) for p in dumps]
+    # the crash dump (an armed-at-exit recorder also dumps at atexit)
+    by_reason = [d for d in docs if d["reason"] == "exception"]
+    assert by_reason, [d["reason"] for d in docs]
+    doc = by_reason[-1]
+    kinds = [e.get("kind") for e in doc["events"]]
+    assert "crash" in kinds and "numerics" in kinds
+    # the injected NaN is attributed to the INJECTED layer: boundary 2
+    # (Dense and tanh outputs are finite; `bad`'s output is not)
+    first = doc["context"]["first_nonfinite"]
+    assert first["kind"] == "activation"
+    assert first["name"].startswith("layer2:"), first
+    num = doc["context"]["numerics"]
+    assert num["act_nonfinite"][first["name"]] > 0
+    # and the numerics event stream carried the same attribution
+    events = [json.loads(line) for line in
+              open(os.path.join(out, "events.jsonl"))]
+    num_events = [ev for ev in events if ev["kind"] == "numerics"]
+    assert num_events
+    assert num_events[0]["first_nonfinite"]["name"].startswith("layer2:")
+
+
+def test_subprocess_sigterm_leaves_flight_dump(tmp_path):
+    """SIGTERM mid-training: the module-level handler dumps every live
+    recorder before the default disposition kills the process."""
+    out = str(tmp_path / "mon")
+    body = f"""
+import signal
+assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+e = engine({out!r})
+for i in range(3):
+    e.train_batch(batch=mk(i))
+print("READY", flush=True)
+import time
+time.sleep(30)
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    script = _CHILD_PRELUDE.format(repo=REPO) + body
+    proc = subprocess.Popen([sys.executable, "-c", script], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        deadline = time.time() + 180
+        line = ""
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if "READY" in line or not line:
+                break
+        assert "READY" in line, line
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc != 0
+    dumps = list_flight_dumps(out)
+    assert dumps, "SIGTERM'd subprocess left no flight dump"
+    doc = json.load(open(dumps[-1]))
+    assert doc["reason"] in ("sigterm", "atexit")
+    assert doc["step"] == 3

@@ -1,0 +1,346 @@
+"""Numerics health (ISSUE 7 tentpole c) + peak_flops_override.
+
+Covers:
+  * fence-alignment guards with monitor.numerics enabled: ZERO
+    per-step device_get/effects_barrier, exactly ONE device_get per
+    fence (the health arrays ride the same fused fetch);
+  * per-group grad stats + per-layer activation stats end to end:
+    JSONL `numerics` events + tfevents round-trip of the flattened
+    numerics scalars;
+  * first-NaN attribution (in-process twin of the subprocess
+    acceptance test) including through registry compaction;
+  * fold_entries/summarize_window unit behavior;
+  * monitor.peak_flops_override: MFU reported on CPU runs.
+"""
+
+import json
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor import Monitor, numerics
+from deepspeed_tpu.monitor.registry import MetricsRegistry
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+from simple_model import SimpleModel
+
+
+def _make_stacked(seed, bs=16, dim=8):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(bs, dim).astype(np.float32)
+    w = np.linspace(-1, 1, dim * dim).reshape(dim, dim).astype(np.float32)
+    return {"x": x[None], "y": (x @ w)[None]}
+
+
+def _engine(tmp_path, sinks=("jsonl",), steps_per_sync=10000,
+            extra=None, **mon_extra):
+    model = SimpleModel(hidden_dim=8)
+    cfg = {
+        "train_batch_size": 16,
+        "steps_per_print": 10000,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "async_dispatch": {"enabled": True,
+                           "steps_per_sync": steps_per_sync},
+    }
+    cfg.update(extra or {})
+    cfg["monitor"] = {"enabled": True, "sinks": list(sinks),
+                      "output_path": str(tmp_path),
+                      "numerics": {"enabled": True}, **mon_extra}
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params, config=cfg)
+    return engine
+
+
+# ----------------------------------------------------------------------
+# fence-alignment guards (the acceptance criterion: zero NEW syncs)
+# ----------------------------------------------------------------------
+class _SyncCounters:
+    def __init__(self, monkeypatch):
+        self.device_get = 0
+        self.effects_barrier = 0
+        real_get, real_barrier = jax.device_get, jax.effects_barrier
+
+        def counting_get(x):
+            self.device_get += 1
+            return real_get(x)
+
+        def counting_barrier():
+            self.effects_barrier += 1
+            return real_barrier()
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        monkeypatch.setattr(jax, "effects_barrier", counting_barrier)
+
+
+def test_numerics_hot_path_zero_per_step_syncs(tmp_path, monkeypatch):
+    """monitor.numerics=on adds NO per-step host<->device sync: the
+    stat arrays are jitted-step outputs retained by a list append."""
+    engine = _engine(tmp_path)
+    assert engine._numerics_on
+    batches = [engine.stage_batch(_make_stacked(i)) for i in range(8)]
+    for b in batches[:3]:
+        engine.train_batch(batch=b)
+    counters = _SyncCounters(monkeypatch)
+    for b in batches[3:]:
+        engine.train_batch(batch=b)
+    assert counters.device_get == 0, \
+        f"numerics hot path device_get x{counters.device_get}"
+    assert counters.effects_barrier == 0
+    engine.monitor.close()
+
+
+def test_numerics_fence_still_costs_exactly_one_device_get(
+        tmp_path, monkeypatch):
+    """The health arrays ride the SAME single per-fence device_get as
+    the scalar metrics — numerics must not add a second fetch."""
+    engine = _engine(tmp_path, steps_per_sync=4)
+    batches = [engine.stage_batch(_make_stacked(i)) for i in range(16)]
+    for b in batches[:8]:
+        engine.train_batch(batch=b)
+    assert engine._host_steps == 8
+    counters = _SyncCounters(monkeypatch)
+    for b in batches[8:]:
+        engine.train_batch(batch=b)
+    assert counters.device_get == 2, \
+        f"expected 1 device_get per fence (2 fences), got " \
+        f"{counters.device_get}"
+    assert counters.effects_barrier == 0
+    log = os.path.join(str(tmp_path), "events.jsonl")
+    kinds = [json.loads(line)["kind"] for line in open(log)]
+    assert kinds.count("numerics") >= 2
+    engine.monitor.close()
+
+
+# ----------------------------------------------------------------------
+# event stream: JSONL + tfevents round-trip
+# ----------------------------------------------------------------------
+def test_numerics_events_roundtrip_jsonl_and_tfevents(tmp_path):
+    engine = _engine(tmp_path, sinks=("jsonl", "tensorboard"),
+                     steps_per_sync=2)
+    for i in range(4):
+        engine.train_batch(batch=_make_stacked(i))
+    engine.monitor.close()
+
+    log = os.path.join(str(tmp_path), "events.jsonl")
+    events = [json.loads(line) for line in open(log)]
+    nums = [e for e in events if e["kind"] == "numerics"]
+    assert nums
+    for e in nums:
+        # SimpleModel grad groups: its two top-level params
+        assert set(e["grad_norm"]) == {"w", "b"}
+        assert all(np.isfinite(v) for v in e["grad_norm"].values())
+        assert set(e["grad_absmax"]) == {"w", "b"}
+        assert e["grad_nonfinite"] == {"w": 0, "b": 0}
+        assert e["first_nonfinite"] is None
+        assert e["window_steps"] >= 1
+
+    import glob
+    from deepspeed_tpu.monitor.tfevents import read_tfevents
+    tb = glob.glob(os.path.join(str(tmp_path), "tb",
+                                "events.out.tfevents.*"))
+    assert tb
+    tags = set()
+    for e in read_tfevents(tb[0]):
+        tags |= set(e.get("scalars", {}))
+    assert "monitor/numerics/grad_norm/w" in tags
+    assert "monitor/numerics/grad_nonfinite/b" in tags
+
+
+def test_snapshot_carries_numerics_and_stable_keys(tmp_path):
+    engine = _engine(tmp_path, sinks=())
+    for i in range(3):
+        engine.train_batch(batch=_make_stacked(i))
+    snap = engine.monitor.snapshot()
+    assert set(snap) == set(Monitor.SNAPSHOT_KEYS)
+    assert snap["numerics"] is not None
+    assert set(snap["numerics"]["grad_norm"]) == {"w", "b"}
+    engine.monitor.close()
+
+
+# ----------------------------------------------------------------------
+# first-NaN attribution (in-process twin of the subprocess acceptance)
+# ----------------------------------------------------------------------
+def _nan_layer(x):
+    return x + jnp.log(-jnp.ones_like(x))
+
+
+def _nan_pipe_engine(tmp_path, steps_per_sync=1):
+    layers = [LayerSpec(nn.Dense, 16), jnp.tanh, _nan_layer,
+              LayerSpec(nn.Dense, 8)]
+    module = PipelineModule(
+        layers, num_stages=1,
+        loss_fn=lambda y, lab: jnp.mean(
+            (y - lab.astype(jnp.float32)[..., :8]) ** 2))
+    params = module.init_params(jax.random.PRNGKey(0),
+                                jnp.zeros((16, 8), jnp.float32))
+    cfg = {
+        "train_batch_size": 16, "steps_per_print": 10000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "async_dispatch": {"enabled": True,
+                           "steps_per_sync": steps_per_sync},
+        "mesh": {"pipe": 1, "data": 8, "model": 1},
+        "monitor": {"enabled": True, "sinks": [],
+                    "output_path": str(tmp_path),
+                    "numerics": {"enabled": True}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params, config=cfg)
+    return engine
+
+
+def _flat_batch(seed):
+    # the pipe engine collects a FULL batch (no stacked gas dim)
+    return {k: v[0] for k, v in _make_stacked(seed).items()}
+
+
+def test_first_nan_layer_attribution(tmp_path):
+    engine = _nan_pipe_engine(tmp_path)
+    engine.train_batch(batch=_flat_batch(0))
+    num = engine.monitor._last_numerics
+    assert num is not None
+    first = num["first_nonfinite"]
+    # boundaries 0 (Dense) and 1 (tanh) are finite; 2 (_nan_layer) is
+    # the injection point — activation attribution outranks the (also
+    # nonfinite) gradients
+    assert first["kind"] == "activation"
+    assert first["name"].startswith("layer2:"), first
+    assert first["index"] == 2
+    assert num["act_nonfinite"][first["name"]] > 0
+    # sticky across later windows (poisoned params blame layer 0 after
+    # the first update — the forensic answer stays the FIRST window)
+    engine.train_batch(batch=_flat_batch(1))
+    assert engine.monitor._first_nonfinite["name"].startswith("layer2:")
+    engine.monitor.close()
+
+
+def test_first_nan_attribution_survives_compaction(tmp_path):
+    """The first-bad candidate is folded on DEVICE at compaction, so a
+    long fence window (> _COMPACT_AT steps) keeps the attribution."""
+    engine = _nan_pipe_engine(tmp_path, steps_per_sync=10000)
+    engine.monitor.registry._COMPACT_AT = 4
+    for i in range(10):     # 2 compactions before any fence
+        engine.train_batch(batch=_flat_batch(i))
+    assert len(engine.monitor.registry._pending_health) < 4
+    snap = engine.monitor.snapshot()
+    first = snap["numerics"]["first_nonfinite"]
+    assert first["kind"] == "activation"
+    assert first["name"].startswith("layer2:")
+    assert first["window_step"] == 0
+    engine.monitor.close()
+
+
+# ----------------------------------------------------------------------
+# unit: fold_entries / summarize_window / group_paths
+# ----------------------------------------------------------------------
+def test_group_paths_and_grad_group_stats():
+    tree = {"block0": {"w": jnp.ones((2, 2)), "b": jnp.zeros((2,))},
+            "block1": {"w": jnp.full((2,), jnp.inf)}}
+    names = numerics.group_paths(tree)
+    assert names == ["block0/b", "block0/w", "block1/w"] or \
+        len(names) == 3
+    stats = np.asarray(numerics.grad_group_stats(tree))
+    assert stats.shape == (len(names), 3)
+    by = dict(zip(names, stats))
+    assert by["block0/w"][0] == pytest.approx(2.0)      # l2 norm
+    assert by["block0/w"][1] == pytest.approx(1.0)      # absmax
+    assert by["block0/w"][2] == 0                       # finite
+    assert by["block1/w"][2] == 1    # nonfinite flag (derived, 2-pass)
+    # NaN leaves flag too (max/sum both propagate)
+    nan_tree = {"g": {"w": jnp.asarray([1.0, np.nan])}}
+    assert np.asarray(numerics.grad_group_stats(nan_tree))[0, 2] == 1
+
+
+def test_fold_entries_and_summarize_merge():
+    acts = [np.array([[1.0, 0.5, 0.0], [2.0, 0.5, 0.0]], np.float32),
+            np.array([[3.0, 0.5, 0.0], [np.inf, 0.5, 2.0]], np.float32)]
+    grads = [np.array([[1.0, 0.1, 0.0]], np.float32),
+             np.array([[np.nan, np.nan, 4.0]], np.float32)]
+    entries = [(i, {"act": jnp.asarray(acts[i]),
+                    "grad": jnp.asarray(grads[i])}) for i in range(2)]
+    acc = numerics.fold_entries([s for s, _ in entries],
+                                [h for _, h in entries], None)
+    acc = jax.device_get(acc)
+    # compacted-only summary
+    out = numerics.summarize_window([], acc,
+                                    grad_names=["g0"],
+                                    act_names=["l0", "l1"])
+    assert out["act_absmax"]["l0"] == 3.0
+    assert out["act_nonfinite"]["l1"] == 2
+    assert out["grad_nonfinite"]["g0"] == 4
+    # act (window_step 1, layer 1) fires before the grad of the same
+    # step
+    assert out["first_nonfinite"] == {
+        "kind": "activation", "name": "l1", "index": 1,
+        "window_step": 1}
+    # tail entries merge with the accumulator: earlier acc candidate
+    # wins over a later tail one
+    tail = [(5, {"act": jnp.asarray(acts[1]),
+                 "grad": jnp.asarray(grads[0])})]
+    out2 = numerics.summarize_window(
+        [(s, jax.device_get(h)) for s, h in tail], acc,
+        grad_names=["g0"], act_names=["l0", "l1"])
+    assert out2["first_nonfinite"]["window_step"] == 1
+    assert out2["act_nonfinite"]["l1"] == 4        # 2 + 2
+
+
+def test_summarize_window_handles_grad_only():
+    entries = [(0, {"grad": np.array([[1.0, 0.5, 0.0]], np.float32),
+                    "act": None})]
+    out = numerics.summarize_window(entries, None, grad_names=["g0"],
+                                    act_names=None)
+    assert out["grad_norm"] == {"g0": 1.0}
+    assert out["act_absmax"] is None
+    assert out["first_nonfinite"] is None
+
+
+def test_registry_health_rides_drain():
+    reg = MetricsRegistry()
+    h = {"grad": jnp.asarray([[1.0, 0.5, 0.0]]), "act": None}
+    reg.fold_step(loss=1.0, grad_norm=1.0, loss_scale=1.0,
+                  overflow=False, tokens=10, health=h)
+    reg.fold_step(loss=2.0, grad_norm=1.0, loss_scale=1.0,
+                  overflow=False, tokens=10)       # health-less step
+    out = reg.drain_device()
+    entries, acc = out["health"]
+    assert len(entries) == 1 and acc is None
+    assert entries[0][0] == 0
+    assert reg.drain_device() is None
+
+
+# ----------------------------------------------------------------------
+# peak_flops_override satellite
+# ----------------------------------------------------------------------
+def test_peak_flops_override_reports_mfu_on_cpu(tmp_path):
+    model = SimpleModel(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={"train_batch_size": 16, "steps_per_print": 4,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "monitor": {"enabled": True, "sinks": [],
+                            "output_path": str(tmp_path),
+                            "peak_flops_override": 1e9}})
+    for i in range(10):
+        engine.train_batch(batch=_make_stacked(i))
+    snap = engine.monitor.snapshot()
+    assert snap["tokens_per_sec_per_chip"] is not None
+    if jax.devices()[0].platform != "tpu":
+        # PR 6 left mfu None off-TPU; the override supplies the
+        # denominator
+        assert snap["mfu"] is not None and snap["mfu"] > 0
+    engine.monitor.close()
+
+
+def test_peak_flops_override_validation():
+    from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
+                                              MonitorConfigError)
+    cfg = DeepSpeedMonitorConfig(
+        {"monitor": {"peak_flops_override": 197e12}})
+    assert cfg.peak_flops_override == 197e12
+    with pytest.raises(MonitorConfigError):
+        DeepSpeedMonitorConfig({"monitor": {"peak_flops_override": -1}})
+    assert DeepSpeedMonitorConfig({}).peak_flops_override == 0.0

@@ -1,0 +1,283 @@
+"""Perfetto trace export (ISSUE 7 tentpole a).
+
+Covers:
+  * Chrome trace-event schema validation: every emitted event carries
+    the required keys, "X" durations are non-negative, `ts` is
+    monotonic within a track, and any B/E events pair up (we emit only
+    X/i/C/M — the validator enforces the rule anyway);
+  * TraceExporter unit behavior: tracks, bounded buffer, atomic write;
+  * the acceptance run: a p=4 / m=8 / v=2 interleaved pipeline on the
+    virtual mesh exports a trace with one track per stage, per-
+    microbatch/per-chunk events, and a computed bubble fraction
+    matching the schedule's analytic (p-1)/(v·m+p-1);
+  * bin/ds_trace merge + summary via the CLI entry point;
+  * span tracks riding trace export without wall_clock_breakdown.
+"""
+
+import json
+import os
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.trace_export import (
+    TraceExporter, analytic_bubble_fraction, load_trace, merge_traces,
+    summarize_trace, tables_bubble_fraction)
+from deepspeed_tpu.runtime.pipe.interp import build_clock_tables
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+DIN, DOUT = 16, 8
+
+
+def mse_loss(pred, labels):
+    return jnp.mean((pred.astype(jnp.float32) -
+                     labels.astype(jnp.float32)) ** 2)
+
+
+# ----------------------------------------------------------------------
+# schema validation helper (the contract every exported file meets)
+# ----------------------------------------------------------------------
+REQUIRED_KEYS = ("name", "ph", "pid", "tid")
+
+
+def validate_chrome_trace(doc):
+    """Assert `doc` is a valid Chrome trace-event object: required keys
+    per event, numeric non-negative durations, monotonic `ts` within
+    each (pid, tid) track, matched B/E pairs per track."""
+    assert isinstance(doc, dict) and "traceEvents" in doc
+    last_ts = {}
+    open_b = {}
+    for ev in doc["traceEvents"]:
+        for key in REQUIRED_KEYS:
+            assert key in ev, (key, ev)
+        ph = ev["ph"]
+        track = (ev["pid"], ev["tid"])
+        if ph == "M":
+            continue
+        assert isinstance(ev.get("ts"), (int, float)), ev
+        assert ev["ts"] >= last_ts.get(track, float("-inf")), \
+            f"ts not monotonic within track {track}: {ev}"
+        last_ts[track] = ev["ts"]
+        if ph == "X":
+            assert isinstance(ev.get("dur"), (int, float)) and \
+                ev["dur"] >= 0, ev
+        elif ph == "B":
+            open_b.setdefault(track, []).append(ev["name"])
+        elif ph == "E":
+            stack = open_b.get(track) or []
+            assert stack, f"E without B on track {track}: {ev}"
+            stack.pop()
+        elif ph in ("i", "I"):
+            assert ev.get("s", "t") in ("t", "p", "g"), ev
+        elif ph == "C":
+            assert isinstance(ev.get("args"), dict) and ev["args"], ev
+    for track, stack in open_b.items():
+        assert not stack, f"unmatched B events on {track}: {stack}"
+
+
+# ----------------------------------------------------------------------
+# exporter unit behavior
+# ----------------------------------------------------------------------
+def test_exporter_events_validate_and_tracks_are_named():
+    ex = TraceExporter(rank=3, max_events=100)
+    ex.complete("host/forward", "forward", 1.0, 0.25)
+    ex.complete("host/forward", "forward", 2.0, 0.5,
+                args={"step": 1})
+    ex.instant("fences", "fence step 1")
+    ex.counter("fences", "metrics", {"loss": 1.5})
+    doc = ex.to_dict()
+    validate_chrome_trace(doc)
+    assert all(ev["pid"] == 3 for ev in doc["traceEvents"])
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["ph"] == "M"}
+    assert {"host/forward", "fences"} <= names
+
+
+def test_exporter_buffer_is_bounded():
+    ex = TraceExporter(max_events=10)
+    for i in range(50):
+        ex.complete("t", f"e{i}", float(i), 0.1)
+    doc = ex.to_dict()
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    assert len(xs) == 10
+    assert xs[0]["name"] == "e40"      # retains the LAST window
+
+
+def test_exporter_atomic_write(tmp_path):
+    ex = TraceExporter()
+    ex.complete("t", "e", 1.0, 0.1)
+    path = str(tmp_path / "sub" / "trace.json")
+    out = ex.write(path)
+    assert out == path and os.path.exists(path)
+    assert not [n for n in os.listdir(tmp_path / "sub")
+                if ".tmp" in n]
+    validate_chrome_trace(load_trace(path))
+
+
+# ----------------------------------------------------------------------
+# pipeline timeline from clock tables
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("m,S,v", [(8, 4, 2), (8, 4, 1), (8, 2, 4)])
+def test_pipeline_events_match_tables_and_bubble(m, S, v):
+    tables = build_clock_tables(m, S, num_virtual_stages=v)
+    ex = TraceExporter()
+    meta = {"stages": S, "micro_batches": m, "num_virtual_stages": v}
+    ex.add_pipeline_step(tables, meta, 10.0, 11.0, step=1)
+    doc = ex.to_dict()
+    validate_chrome_trace(doc)
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    busy = int((tables["fwd_mb"] >= 0).sum() +
+               (tables["bwd_mb"] >= 0).sum())
+    assert len(xs) == busy
+    # every (chunk, mb) fwd+bwd appears exactly once, args intact
+    seen_f = {(e["args"]["chunk"], e["args"]["mb"]) for e in xs
+              if e["name"].startswith("F ")}
+    assert seen_f == {(q, mb) for q in range(S * v) for mb in range(m)}
+    # the metadata's computed bubble equals the table bubble, near the
+    # schedule's analytic number
+    pipe = doc["otherData"]["pipeline"]
+    assert pipe["bubble_fraction"] == pytest.approx(
+        tables_bubble_fraction(tables), abs=1e-6)
+    assert pipe["analytic_bubble_fraction"] == pytest.approx(
+        analytic_bubble_fraction(S, m, v), abs=1e-6)
+    # and the summary recomputed FROM EVENTS agrees
+    summary = summarize_trace(doc)
+    assert summary["pipeline"]["stages"] == S
+    assert summary["pipeline"]["bubble_fraction"] == pytest.approx(
+        tables_bubble_fraction(tables), abs=0.02)
+
+
+# ----------------------------------------------------------------------
+# acceptance: p=4/m=8/v=2 engine run -> trace -> bubble vs analytic
+# ----------------------------------------------------------------------
+def _pipe_engine(tmp_path, v=2, gas=8, pipe=4):
+    layers = [LayerSpec(nn.Dense, 32), jnp.tanh, LayerSpec(nn.Dense, 32),
+              LayerSpec(nn.Dense, 32), LayerSpec(nn.Dense, 32), jnp.tanh,
+              LayerSpec(nn.Dense, 32), LayerSpec(nn.Dense, DOUT)]
+    module = PipelineModule(layers, num_stages=pipe, loss_fn=mse_loss,
+                            partition_method="uniform")
+    rng = np.random.RandomState(0)
+    example = jnp.asarray(rng.randn(4, DIN), jnp.float32)
+    params = module.init_params(jax.random.PRNGKey(0), example)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 8,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 1000,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "mesh": {"pipe": pipe, "data": 8 // pipe, "model": 1},
+        "pipeline": {"num_virtual_stages": v},
+        "monitor": {"enabled": True, "sinks": [],
+                    "output_path": str(tmp_path),
+                    "trace": {"enabled": True}},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=module, model_parameters=params, config=cfg)
+    return engine
+
+
+def _pipe_batch(gas, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(8 * gas, DIN).astype(np.float32)
+    w = np.linspace(-1, 1, DIN * DOUT).reshape(DIN, DOUT) \
+        .astype(np.float32)
+    return {"x": x, "y": x @ w}
+
+
+def test_interleaved_pipeline_run_exports_valid_trace(tmp_path):
+    """The acceptance criterion: a p=4/m=8/v=2 virtual-mesh pipeline
+    run exports trace-event JSON that validates, carries per-stage
+    tracks with microbatch/chunk events, and whose computed bubble
+    matches the schedule's analytic (p-1)/(v·m+p-1)."""
+    p, m, v = 4, 8, 2
+    engine = _pipe_engine(tmp_path, v=v, gas=m, pipe=p)
+    for i in range(3):
+        engine.train_batch(batch=_pipe_batch(m, i))
+    path = engine.monitor.export_trace()
+    engine.monitor.close()
+    assert path and os.path.exists(path)
+
+    doc = load_trace(path)
+    validate_chrome_trace(doc)
+    names = {ev["args"]["name"] for ev in doc["traceEvents"]
+             if ev["ph"] == "M"}
+    assert {f"pipe/stage{s}" for s in range(p)} <= names
+    xs = [ev for ev in doc["traceEvents"]
+          if ev["ph"] == "X" and ev.get("cat", "").startswith("pipe")]
+    assert xs, "no pipeline events in the trace"
+    assert all({"mb", "chunk", "step"} <= set(e["args"]) for e in xs)
+    chunks = {e["args"]["chunk"] for e in xs}
+    mbs = {e["args"]["mb"] for e in xs}
+    assert chunks == set(range(p * v))
+    assert mbs == set(range(m))
+
+    analytic = analytic_bubble_fraction(p, m, v)    # 3/19 ~ 0.158
+    summary = summarize_trace(doc)
+    measured = summary["pipeline"]["bubble_fraction"]
+    assert measured == pytest.approx(analytic, abs=0.05), \
+        (measured, analytic)
+    assert doc["otherData"]["pipeline"]["analytic_bubble_fraction"] \
+        == pytest.approx(analytic, abs=1e-6)
+
+
+def test_span_tracks_ride_trace_export_without_breakdown(tmp_path):
+    """monitor.trace.enabled alone records the step spans as slices —
+    no wall_clock_breakdown flag required."""
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from simple_model import SimpleModel
+    model = SimpleModel(hidden_dim=8)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=model.params,
+        config={
+            "train_batch_size": 16, "steps_per_print": 10000,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "monitor": {"enabled": True, "sinks": [],
+                        "output_path": str(tmp_path),
+                        "trace": {"enabled": True}},
+        })
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    for _ in range(3):
+        engine.train_batch(batch={"x": x[None], "y": (x * 0.5)[None]})
+    doc = engine.monitor.trace_export.to_dict()
+    engine.monitor.close()
+    validate_chrome_trace(doc)
+    step_slices = [ev for ev in doc["traceEvents"]
+                   if ev["ph"] == "X" and ev["name"] == "step"]
+    assert len(step_slices) == 3
+
+
+# ----------------------------------------------------------------------
+# ds_trace CLI: merge + summary
+# ----------------------------------------------------------------------
+def test_ds_trace_merge_and_summary(tmp_path, capsys):
+    tables = build_clock_tables(8, 4, num_virtual_stages=2)
+    meta = {"stages": 4, "micro_batches": 8, "num_virtual_stages": 2}
+    paths = []
+    for rank in range(2):
+        ex = TraceExporter(rank=rank)
+        ex.add_pipeline_step(tables, meta, 10.0, 11.0, step=1)
+        ex.complete("host/step", "step", 10.0, 0.9)
+        paths.append(ex.write(str(tmp_path / f"trace_rank{rank}.json")))
+
+    merged = merge_traces([load_trace(path) for path in paths])
+    validate_chrome_trace(merged)
+    assert merged["otherData"]["merged_ranks"] == 2
+    assert {ev["pid"] for ev in merged["traceEvents"]} == {0, 1}
+
+    from deepspeed_tpu.monitor.trace_cli import main
+    out = str(tmp_path / "merged.json")
+    assert main(["merge", *paths, "-o", out]) == 0
+    printed = capsys.readouterr().out
+    assert "merged 2 shard(s)" in printed
+    assert "bubble_fraction" in printed
+    validate_chrome_trace(load_trace(out))
+
+    assert main(["summary", out]) == 0
+    printed = capsys.readouterr().out
+    assert "pipe/stage0" in printed
+    assert "schedule analytic" in printed

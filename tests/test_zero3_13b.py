@@ -98,7 +98,7 @@ def run_zero3_sr_memory_check(model_name, overrides, steps=2,
     def upd(state, lr):
         grads = jax.tree_util.tree_map(
             lambda l: jnp.zeros(l.shape, jnp.bfloat16), enc_template)
-        new_state, _, gnorm = engine._unscale_clip_and_update(
+        new_state, _, gnorm, _health = engine._unscale_clip_and_update(
             state, lr, grads=grads)
         return new_state, gnorm
 
