@@ -915,10 +915,8 @@ def bench_13b_memory_plan():
     no 13B allocation happens). The execution path itself is validated
     by the driver's dryrun_multichip on tiny shapes; this records that
     the REAL config's optimizer state divides across the mesh."""
-    import jax.numpy as jnp
     from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, gpt2_config
     from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
-    from jax.sharding import PartitionSpec
 
     cfg = gpt2_config("gpt2-13b", n_positions=1024, dropout=0.0)
     model = GPT2ForCausalLM(cfg)
@@ -932,26 +930,15 @@ def bench_13b_memory_plan():
     policy = ZeroShardingPolicy(MeshShim(), stage=3)
     plan = policy.pad_plan(shapes)
 
-    def sharded_bytes(specs_fn, bytes_per_elem):
-        specs = specs_fn(shapes)
-        total = 0.0
-        for leaf, spec in zip(
-                jax.tree_util.tree_leaves(shapes),
-                jax.tree_util.tree_leaves(
-                    specs, is_leaf=lambda x: isinstance(x,
-                                                        PartitionSpec))):
-            frac = 1.0
-            for axis in spec:
-                if axis is not None:
-                    frac /= MeshShim.shape[axis]
-            total += int(np.prod(leaf.shape)) * bytes_per_elem * frac
-        return total
-
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(shapes))
-    # bf16 params (stage-3 sharded) + fp32 master + 2 fp32 adam moments
-    per_dev = (sharded_bytes(policy.param_pspecs, 2) +
-               3 * sharded_bytes(policy.master_pspecs, 4))
+    # bf16 params (stage-3 sharded) + fp32 master + 2 fp32 adam
+    # moments — the per-component closed form the memory ledger
+    # validates against (ZeroShardingPolicy.memory_plan; the
+    # memory_ledger bench leg scores it vs a LIVE engine)
+    comp = policy.memory_plan(shapes, compute_bytes=2, sr_mode=False,
+                              gas=1)
+    per_dev = comp["params"] + comp["master"] + comp["opt_state"]
     return {"params_b": round(n_params / 1e9, 2),
             "mesh": dict(MeshShim.shape),
             "padded_leaves": len(plan),
@@ -964,6 +951,204 @@ def bench_13b_memory_plan():
             # depth-repeated, structure-identical), gated DS_TPU_RUN_13B=1
             # because the full run needs ~110 GB host RAM
             "executed_validation": "tests/test_zero3_13b.py"}
+
+
+def bench_memory_ledger():
+    """Memory-ledger plan-vs-measured validation + overhead guard
+    (ISSUE 8). Three parts:
+
+    (a) 13B plan vs ledger arithmetic, abstract: the per-component
+        `ZeroShardingPolicy.memory_plan` at the 128-chip bf16
+        master-less config against the closed-form 6 B/param / dp —
+        the two derivations must agree, or the feasibility number the
+        ZeRO-3 roadmap leans on is wrong.
+    (b) EXECUTED plan-vs-ledger-vs-measured on the live mesh: a scaled
+        GPT-2 through the exact 13B code path (bf16 SR ZeRO-3, sharded
+        init), per-component deltas between the plan formula, what the
+        ledger registered, and real per-device shard bytes
+        (addressable_shards — a measurement, not arithmetic).
+    (c) overhead guard: paired order-alternating A/B windows (the
+        numerics_overhead methodology), monitor ON both legs, memory
+        ledger off vs on — reconciliation is fence-aligned host dict
+        math and must stay inside the monitor's <3% contract."""
+    import shutil
+    import tempfile
+    import jax.numpy as jnp
+    from deepspeed_tpu.models.gpt2 import (GPT2ForCausalLM,
+                                           gpt2_config,
+                                           tiny_gpt2_config)
+    from deepspeed_tpu.monitor.memory import plan_vs_measured
+    from deepspeed_tpu.runtime.mesh import build_mesh
+    from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
+    from deepspeed_tpu import initialize
+
+    out = {}
+
+    # -- (a) 13B abstract: plan components vs the closed form ----------
+    cfg13 = gpt2_config("gpt2-13b", n_positions=1024, dropout=0.0)
+    shapes13 = jax.eval_shape(
+        lambda: GPT2ForCausalLM(cfg13).init(
+            jax.random.PRNGKey(0),
+            {"input_ids": np.zeros((1, 1024), np.int32)}))
+
+    class MeshShim:
+        shape = {"pipe": 1, "data": 128, "model": 1}
+
+    plan13 = ZeroShardingPolicy(MeshShim(), 3).memory_plan(
+        shapes13, compute_bytes=2, sr_mode=True, gas=1)
+    n13 = sum(int(np.prod(l.shape))
+              for l in jax.tree_util.tree_leaves(shapes13))
+    closed_form = 6.0 * n13 / MeshShim.shape["data"]
+    planned13 = plan13["params"] + plan13["opt_state"]
+    out["plan_13b"] = {
+        "params_b": round(n13 / 1e9, 2),
+        "components_gb": {k: round(v / 2**30, 3)
+                          for k, v in plan13.items()},
+        "state_gb_per_device": round(planned13 / 2**30, 3),
+        "closed_form_gb_per_device": round(closed_form / 2**30, 3),
+        # padding of non-divisible leaves makes the plan slightly
+        # larger than 6N/dp, never smaller
+        "vs_closed_form_pct": round(
+            (planned13 - closed_form) / closed_form * 100.0, 3),
+    }
+    assert abs(out["plan_13b"]["vs_closed_form_pct"]) < 5.0, out
+
+    # -- (b) executed: scaled 13B code path, plan vs ledger vs measured
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"pipe": 1, "data": n_dev, "model": 1})
+    cfg_s = gpt2_config("gpt2-125m", dropout=0.0, dtype=jnp.bfloat16,
+                        param_dtype=jnp.bfloat16, vocab_size=512,
+                        n_positions=64, n_layer=2)
+    model = GPT2ForCausalLM(cfg_s)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        {"input_ids": np.zeros((n_dev, 64), np.int32)})
+    tmp = tempfile.mkdtemp(prefix="ds_memledger_bench_")
+    try:
+        engine, _, _, _ = initialize(
+            model=model, model_parameters=params, mesh=mesh,
+            config={
+                "train_micro_batch_size_per_gpu": n_dev,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 1000,
+                "bf16": {"enabled": True, "master_weights": False},
+                "zero_optimization": {"stage": 3},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                # fence every step so the 3-step run emits memory events
+                "async_dispatch": {"enabled": True, "steps_per_sync": 1},
+                "monitor": {"enabled": True, "sinks": ["jsonl"],
+                            "output_path": tmp},
+            })
+        shapes = jax.eval_shape(lambda t: t, engine.state.params)
+        plan = engine.zero_policy.memory_plan(
+            shapes, compute_bytes=2, sr_mode=True, gas=1)
+        engine.monitor.set_memory_plan(plan)
+        for i in range(3):
+            ids = np.random.default_rng(i).integers(
+                0, cfg_s.vocab_size, (1, n_dev, 64)).astype(np.int32)
+            loss = engine.train_batch(batch={"input_ids": ids})
+        _sync(loss)
+        snap = engine.monitor.snapshot()
+        led = snap["memory_ledger"]
+        cats = led["hbm"]["categories"]
+
+        dev0 = jax.devices()[0]
+
+        def dev_bytes(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if isinstance(leaf, jax.Array):
+                    for sh in leaf.addressable_shards:
+                        if sh.device == dev0:
+                            total += sh.data.nbytes
+            return total
+
+        measured = {"params": dev_bytes(engine.state.params),
+                    "opt_state": dev_bytes(engine.state.opt_state)}
+        out["executed"] = {
+            "devices": n_dev,
+            "plan_vs_ledger": plan_vs_measured(plan, cats),
+            "plan_vs_measured": plan_vs_measured(plan, measured),
+            "ledger_event_plan": led.get("plan") is not None,
+        }
+        for comp in ("params", "opt_state"):
+            for scored in ("plan_vs_ledger", "plan_vs_measured"):
+                d = out["executed"][scored][comp]["delta_pct"]
+                assert d is not None and abs(d) < 15.0, \
+                    (scored, comp, out["executed"][scored])
+        mem_events = sum(
+            1 for line in open(os.path.join(tmp, "events.jsonl"))
+            if json.loads(line).get("kind") == "memory")
+        out["executed"]["memory_events"] = mem_events
+        assert mem_events > 0
+        engine.monitor.close()
+        del engine, params
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # -- (c) overhead guard: memory ledger off vs on -------------------
+    batch, seq = 8, 64
+    steps, warmup, windows = 12, 4, 8
+    cfg_t = tiny_gpt2_config(n_positions=seq, dropout=0.0)
+    tmp = tempfile.mkdtemp(prefix="ds_memledger_ab_")
+
+    def make_batch(i):
+        ids = np.random.default_rng(i).integers(
+            0, cfg_t.vocab_size, (1, batch, seq)).astype(np.int32)
+        return {"input_ids": ids}
+
+    def build(mem_on):
+        model = GPT2ForCausalLM(cfg_t)
+        p = model.init(jax.random.PRNGKey(0),
+                       {"input_ids": np.zeros((batch, seq), np.int32)})
+        engine, _, _, _ = initialize(
+            model=model, model_parameters=p,
+            config={
+                "train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": 1,
+                "steps_per_print": 100000,
+                "bf16": {"enabled": True},
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                # fences every 3 steps: the reconciliation cost must
+                # sit INSIDE the measured window, several times over
+                "async_dispatch": {"enabled": True, "steps_per_sync": 3},
+                "monitor": {"enabled": True, "sinks": ["jsonl"],
+                            "output_path": tmp,
+                            "job_name": "on" if mem_on else "off",
+                            "memory": {"enabled": mem_on}},
+            })
+        del p
+        assert engine.monitor.memory_enabled == mem_on
+        for i in range(warmup):
+            loss = engine.train_batch(batch=make_batch(i))
+        _sync(loss)
+        return engine
+
+    def window(engine, base):
+        t0 = time.perf_counter()
+        for i in range(steps):
+            loss = engine.train_batch(batch=make_batch(base + i))
+        _sync(loss)
+        return time.perf_counter() - t0
+
+    try:
+        engines = {"off": build(False), "on": build(True)}
+        ratios = []
+        for w in range(windows):
+            order = ("off", "on") if w % 2 == 0 else ("on", "off")
+            t = {}
+            for name in order:
+                t[name] = window(engines[name], 1000 + w * steps)
+            ratios.append(t["on"] / t["off"])
+        overhead = (float(np.median(ratios)) - 1.0) * 100.0
+        out["overhead_pct"] = round(overhead, 2)
+        out["windows_measured"] = len(ratios)
+        out["regressed"] = bool(overhead >= 3.0)
+        engines["on"].monitor.close()
+        engines["off"].monitor.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 def bench_offload_overlap():
@@ -1883,6 +2068,7 @@ BENCH_LEGS = {
     "offload_overlap_microbench": bench_offload_overlap,
     "pipe_interp_vs_spmd": bench_pipe_interp_vs_spmd,
     "gpt2_13b_zero3_memory_plan": bench_13b_memory_plan,
+    "memory_ledger": bench_memory_ledger,
 }
 
 

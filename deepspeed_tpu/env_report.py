@@ -152,6 +152,13 @@ def feature_report():
                      "(monitor.numerics)"))
     except Exception as e:
         rows.append(("numerics health", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.monitor.memory import MemoryLedger  # noqa: F401,E501
+        rows.append(("memory ledger",
+                     f"{SUCCESS} HBM/host byte attribution + OOM "
+                     "forensics (monitor.memory, default on)"))
+    except Exception as e:
+        rows.append(("memory ledger", f"{FAIL} {e}"))
 
     print("-" * 64)
     print("runtime feature report")

@@ -32,6 +32,15 @@ Forensic layer (ISSUE 7):
     jitted step, drained in the same one-device_get-per-fence path,
     with sticky first-NaN layer attribution.
 
+Memory layer (ISSUE 8):
+
+  * Memory ledger (memory.py, `monitor.memory`, default on): every
+    long-lived allocation site registers its logical buffers by
+    category from shape metadata; fences reconcile ledger vs
+    device_memory_stats + host RSS into a `memory` event with
+    per-category attribution, a peak watermark (attribution AT peak),
+    Perfetto counter tracks, and OOM-classified flight dumps.
+
 The Monitor object orchestrates these against one engine; every
 hook is a no-op behind a single attribute check when
 `monitor.enabled` is false (the default).
@@ -41,9 +50,11 @@ import os
 import time
 import weakref
 
+from deepspeed_tpu.monitor import memory as memory_mod
 from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
                                           MonitorConfigError)
 from deepspeed_tpu.monitor.flight import FlightRecorder
+from deepspeed_tpu.monitor.memory import MemoryLedger
 from deepspeed_tpu.monitor.registry import MetricsRegistry
 from deepspeed_tpu.monitor.sinks import (SCHEMA_VERSION, base_event,
                                          build_sinks)
@@ -56,7 +67,7 @@ from deepspeed_tpu.monitor.watchdog import StallWatchdog
 
 __all__ = [
     "Monitor", "MetricsRegistry", "StepTrace", "StallWatchdog",
-    "FlightRecorder", "TraceExporter",
+    "FlightRecorder", "TraceExporter", "MemoryLedger",
     "DeepSpeedMonitorConfig", "MonitorConfigError", "SCHEMA_VERSION",
     "SPAN_FORWARD", "SPAN_BACKWARD", "SPAN_STEP", "SPAN_CKPT",
     "SPAN_PREFETCH",
@@ -101,6 +112,17 @@ class Monitor:
         self._hb = {}
         self._hb_terminal = set()
         self._numerics_names = {"grad": None, "act": None}
+        # the memory ledger exists even when the monitor is disabled:
+        # allocation sites register unconditionally (init-time shape
+        # math, no per-step cost) so enabling the monitor later — or a
+        # user-initiated snapshot — still has full attribution
+        self.ledger = MemoryLedger()
+        self._last_memory = None
+        # categories last emitted nonzero per counter series: a
+        # released buffer must emit one explicit 0 — Chrome counter
+        # semantics keep the last seen value per key, so omitting it
+        # would freeze the stacked area at its old height forever
+        self._mem_counter_keys = {"hbm": set(), "host": set()}
         # gauges register even when disabled so snapshot() keeps its
         # stable key set on a monitor-off engine
         self._register_default_gauges()
@@ -179,8 +201,15 @@ class Monitor:
         self.registry.add_gauge("memory", device_memory_stats)
 
     def attach_prefetch(self, loader):
-        """Remember the live PrefetchLoader for the occupancy gauge."""
+        """Remember the live PrefetchLoader for the occupancy gauge and
+        the memory ledger's dynamic prefetch-staging entry (occupancy x
+        staged-batch bytes, sampled at reconcile time; a fresh loader
+        supersedes the previous entry)."""
         self._prefetch_ref = weakref.ref(loader)
+        ref = self._prefetch_ref
+        self.ledger.register_dynamic(
+            memory_mod.CAT_PREFETCH, "prefetch.staged",
+            lambda: (lambda l: l.buffer_bytes() if l else 0)(ref()))
 
     def heartbeat(self, source):
         self._hb[source] = time.monotonic()
@@ -216,6 +245,64 @@ class Monitor:
     @property
     def numerics_enabled(self):
         return self.enabled and self.config.numerics_enabled
+
+    @property
+    def memory_enabled(self):
+        return self.enabled and self.config.memory_enabled
+
+    def set_memory_plan(self, plan):
+        """Attach a per-component ZeRO memory plan ({component: bytes
+        per device}; `ZeroShardingPolicy.memory_plan`): every later
+        `memory` event and trace export carries plan-vs-measured
+        deltas (`bin/ds_trace summary` prints them)."""
+        self.ledger.set_plan(plan)
+        if self.trace_export is not None:
+            self.trace_export.set_meta(
+                memory_plan={k: int(v) for k, v in (plan or {}).items()})
+
+    def _reconcile_memory(self, step):
+        """Fence-aligned ledger reconciliation: pure host arithmetic
+        over shape metadata + one allocator-stats read — zero
+        host<->device syncs (guard-tested). Updates the flight
+        recorder's sticky peak context so an OOM dump names what was
+        alive at the watermark even after the ring rolled."""
+        from deepspeed_tpu.utils.timer import device_memory_stats
+        # device_memory_stats already embeds host_rss_bytes; reconcile
+        # falls back to it — one /proc read per fence, not two
+        payload = self.ledger.reconcile(
+            device_memory_stats(),
+            step=step, top_n=self.config.memory_top_buffers)
+        self._last_memory = payload
+        if self.flight is not None and payload.get("peak"):
+            self.flight.set_context(memory_peak=payload["peak"])
+        return payload
+
+    def _emit_memory_event(self, step):
+        payload = self._reconcile_memory(step)
+        event = base_event("memory", step)
+        event.update(payload)
+        self._emit(event)
+        if self.trace_export is not None:
+            # per-category counter tracks: Perfetto stacks the args of
+            # one counter series, so the HBM timeline reads as a
+            # stacked-by-category area with the residual on top
+            for space in ("hbm", "host"):
+                cats = payload[space]["categories"]
+                live = {c: cats[c] for c in memory_mod.CATEGORIES
+                        if cats.get(c)}
+                # one explicit 0 for categories that just vanished
+                # (e.g. a released ckpt snapshot), then they drop out
+                vals = dict(live)
+                for gone in self._mem_counter_keys[space] - set(live):
+                    vals[gone] = 0
+                self._mem_counter_keys[space] = set(live)
+                res = payload[space]["residual_bytes"]
+                if res is not None:
+                    vals["residual"] = max(res, 0)
+                if vals:
+                    self.trace_export.counter(
+                        "memory", f"{space}_bytes", vals)
+        return event
 
     # ------------------------------------------------------------------
     # hot path
@@ -375,6 +462,8 @@ class Monitor:
             num_event = base_event("numerics", e._host_steps)
             num_event.update(numerics)
             self._emit(num_event)
+        if self.memory_enabled:
+            self._emit_memory_event(e._host_steps)
         self._maybe_flush()
         return event
 
@@ -456,14 +545,37 @@ class Monitor:
 
     def on_crash(self, exc):
         """Uncaught exception out of the step loop: record it and dump
-        the flight ring + trace before the exception propagates."""
+        the flight ring + trace before the exception propagates. A
+        RESOURCE_EXHAUSTED / out-of-memory failure is classified and
+        dumped as reason "oom" with the memory ledger, the top
+        buffers, and actionable hints attached — the attribution dies
+        with the process otherwise."""
         if not self.enabled:
             return
+        extra = {"error": repr(exc)}
+        reason = "exception"
+        if self.memory_enabled and memory_mod.classify_oom(exc):
+            reason = "oom"
+            try:
+                # allocator stats are a host-side read — the failed
+                # allocation left the device responsive; still guarded
+                # because a post-mortem must never raise
+                payload = self._reconcile_memory(
+                    self._flight_step() or 0)
+            except Exception:
+                payload = self._last_memory or \
+                    self.ledger.reconcile(None, None)
+            extra["oom"] = {
+                "hbm": payload.get("hbm"),
+                "host": payload.get("host"),
+                "peak": payload.get("peak"),
+                "top_buffers": payload.get("top_buffers"),
+                "hints": memory_mod.oom_hints(payload),
+            }
         if self.flight is not None:
             try:
                 self.flight.record_exception(exc)
-                self.flight.dump("exception",
-                                 extra={"error": repr(exc)})
+                self.flight.dump(reason, extra=extra)
             except Exception:
                 pass
         self._export_trace_safe()
@@ -517,6 +629,7 @@ class Monitor:
         "loss_scale", "lr", "overflow_count", "tokens",
         "samples_per_sec", "tokens_per_sec_per_chip", "mfu",
         "memory", "wire", "checkpoint", "prefetch", "numerics",
+        "memory_ledger",
     )
 
     def snapshot(self):
@@ -564,6 +677,9 @@ class Monitor:
                 "depth": gauges.get("prefetch/depth"),
             },
             "numerics": self._last_numerics,
+            "memory_ledger": self._reconcile_memory(
+                e._host_steps if e else 0)
+            if self.memory_enabled else None,
         }
         return snap
 

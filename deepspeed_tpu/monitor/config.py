@@ -38,6 +38,11 @@ flight: {"enabled" (default true), "capacity", "path"} — crash/stall
 numerics: {"enabled"} — device-side per-layer numerics health
   (monitor/numerics.py): per-group grad stats + per-layer activation
   stats folded inside the jitted step, drained at the same fences.
+memory: {"enabled" (default true), "top_buffers"} — live HBM/host
+  byte ledger (monitor/memory.py): per-subsystem allocation
+  attribution reconciled against the allocator at every fence, peak
+  watermark with at-peak attribution, Perfetto per-category counter
+  tracks, and OOM forensics on RESOURCE_EXHAUSTED crashes.
 """
 
 from deepspeed_tpu.runtime import constants as C
@@ -140,3 +145,18 @@ class DeepSpeedMonitorConfig:
         self.numerics_enabled = bool(get_scalar_param(
             numerics, C.MONITOR_NUMERICS_ENABLED,
             C.MONITOR_NUMERICS_ENABLED_DEFAULT))
+
+        memory = block.get(C.MONITOR_MEMORY, {})
+        if not isinstance(memory, dict):
+            raise MonitorConfigError(
+                f'"monitor.memory" must be a dict, got {memory!r}')
+        self.memory_enabled = bool(get_scalar_param(
+            memory, C.MONITOR_MEMORY_ENABLED,
+            C.MONITOR_MEMORY_ENABLED_DEFAULT))
+        self.memory_top_buffers = int(get_scalar_param(
+            memory, C.MONITOR_MEMORY_TOP_BUFFERS,
+            C.MONITOR_MEMORY_TOP_BUFFERS_DEFAULT))
+        if self.memory_top_buffers < 0:
+            raise MonitorConfigError(
+                "monitor.memory.top_buffers must be >= 0, got "
+                f"{self.memory_top_buffers}")

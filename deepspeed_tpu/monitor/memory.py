@@ -1,0 +1,407 @@
+"""Memory ledger: live HBM/host byte accounting with attribution.
+
+The monitor stack sees time (spans, pipeline timelines) and values
+(loss, numerics health); this module makes it see MEMORY — the
+resource ZeRO exists to manage. Every long-lived allocation site
+registers its logical buffers here by category, with bytes computed
+from abstract shapes/dtypes and sharding metadata (`shard_shape` is
+pure index math — NO device sync anywhere in this module):
+
+  params          compute-dtype parameters (engine / pipe flat layout)
+  master          device fp32 master copies (mixed precision)
+  opt_state       optimizer moments (device)
+  grads           the persistent fp32 grad accumulator (gas > 1)
+  host_master     ZeRO-Offload fp32 masters in host RAM
+  host_opt_state  ZeRO-Offload CPU-Adam moments in host RAM
+  wire            compressed-wire state: device residual / device flat
+                  param copy / host shadow
+  ckpt_snapshot   checkpoint snapshot double-buffers — alive only
+                  between the jitted snapshot and the writer's commit
+  prefetch        staged batches queued ahead of the step loop
+                  (a DYNAMIC entry: occupancy x staged bytes)
+  pipe_buffers    the 1F1B executor's saved-input/ring buffers
+
+At each existing telemetry fence the Monitor calls `reconcile`, which
+samples the allocator (`device_memory_stats`) and host RSS and splits
+the measured numbers into ledger-known bytes and a RESIDUAL — the
+activations/XLA temporaries no registry can see. The peak watermark
+keeps the attribution snapshot taken AT the fence that observed the
+peak: an OOM post-mortem needs to know what was alive when memory
+crested, not what is alive now.
+
+`classify_oom` + `oom_hints` turn a RESOURCE_EXHAUSTED crash into an
+attributed flight-recorder dump with actionable knobs; `plan_vs_
+measured` scores a ZeRO memory plan (`ZeroShardingPolicy.memory_plan`)
+against the ledger per component — the validation ROADMAP item 2
+(ZeRO-3 at 13B) is contingent on.
+
+Everything here is host-side arithmetic over shape metadata; the
+per-fence cost is a dict walk, guard-tested to add zero per-step
+host<->device syncs.
+"""
+
+import os
+import re
+import threading
+
+MEMORY_SCHEMA_VERSION = 1
+
+SPACE_HBM = "hbm"
+SPACE_HOST = "host"
+
+CAT_PARAMS = "params"
+CAT_MASTER = "master"
+CAT_OPT = "opt_state"
+CAT_GRADS = "grads"
+CAT_HOST_MASTER = "host_master"
+CAT_HOST_OPT = "host_opt_state"
+CAT_WIRE = "wire"
+CAT_CKPT = "ckpt_snapshot"
+CAT_PREFETCH = "prefetch"
+CAT_PIPE = "pipe_buffers"
+
+# canonical ordering for stacked rendering (Perfetto counter tracks,
+# event dicts): state groups first, transients last
+CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS,
+              CAT_HOST_MASTER, CAT_HOST_OPT, CAT_WIRE, CAT_CKPT,
+              CAT_PREFETCH, CAT_PIPE)
+
+
+# ----------------------------------------------------------------------
+# byte arithmetic (shape/dtype metadata only — never a device value)
+# ----------------------------------------------------------------------
+def host_rss_bytes():
+    """Resident set size of this process from /proc/self/statm
+    (stdlib-only; None where /proc is unavailable). The host-space twin
+    of the device allocator gauge: off-TPU (device_count == 0 — the
+    backend exposes no memory_stats) the ledger reconciles against
+    THIS, so CPU/virtual-mesh rehearsal runs keep a meaningful memory
+    signal — the peak_flops_override precedent."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except Exception:
+        return None
+
+
+def leaf_nbytes(leaf, per_device=True):
+    """Logical bytes of one array-like leaf from shape/dtype metadata.
+    `per_device=True` divides a sharded jax.Array by its sharding
+    (`shard_shape` — pure index math, no transfer): the ledger answers
+    "what does ONE device hold", the question HBM pressure asks.
+    Replicated leaves count full-size per device, which is exactly
+    their per-chip cost."""
+    import numpy as np
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    if per_device:
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass
+    return int(np.prod(shape)) * np.dtype(dtype).itemsize
+
+
+def tree_nbytes(tree, per_device=True):
+    """Summed `leaf_nbytes` over a pytree (jax Arrays, numpy arrays,
+    ShapeDtypeStructs — anything with .shape/.dtype)."""
+    import jax
+    return sum(leaf_nbytes(l, per_device=per_device)
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+# ----------------------------------------------------------------------
+# the ledger
+# ----------------------------------------------------------------------
+class MemoryLedger:
+    """Registry of long-lived logical buffers by (category, name).
+
+    Thread-safe: the checkpoint writer registers/releases snapshot
+    entries from its own thread while the fence reconciles. `register`
+    replaces an existing (category, name) entry — a fresh prefetch
+    loader or a resaved checkpoint tag supersedes its predecessor.
+    Dynamic entries hold a zero-arg callable sampled at reconcile time
+    (host-side ints only — e.g. prefetch occupancy x staged bytes).
+    """
+
+    def __init__(self):
+        self._entries = {}       # (category, name) -> entry dict
+        self._lock = threading.Lock()
+        self._peak = None        # attribution snapshot AT the peak
+        self._plan = None        # {component: planned bytes} (hbm)
+
+    # -- registration ---------------------------------------------------
+    def register(self, category, name, nbytes, space=SPACE_HBM,
+                 meta=None):
+        """Register a static entry; returns the token `release` takes."""
+        key = (str(category), str(name))
+        with self._lock:
+            self._entries[key] = {
+                "category": key[0], "name": key[1], "space": space,
+                "bytes": int(nbytes), "fn": None, "meta": meta or {}}
+        return key
+
+    def register_tree(self, category, name, tree, space=SPACE_HBM,
+                      per_device=True, meta=None):
+        """Register a pytree's bytes (sharding-aware, metadata only)."""
+        try:
+            nbytes = tree_nbytes(tree, per_device=per_device)
+        except Exception:
+            nbytes = 0
+        return self.register(category, name, nbytes, space=space,
+                             meta=meta)
+
+    def register_dynamic(self, category, name, fn, space=SPACE_HBM,
+                         meta=None):
+        """Register a callable sampled at reconcile time. The callable
+        must be host-side only (no device access) and may return None
+        (counted as 0)."""
+        key = (str(category), str(name))
+        with self._lock:
+            self._entries[key] = {
+                "category": key[0], "name": key[1], "space": space,
+                "bytes": 0, "fn": fn, "meta": meta or {}}
+        return key
+
+    def release(self, token):
+        """Drop an entry by the token `register` returned (or a
+        (category, name) tuple). Unknown tokens are a no-op — release
+        paths run in finally blocks and must never raise."""
+        try:
+            key = (str(token[0]), str(token[1]))
+        except Exception:
+            return
+        with self._lock:
+            self._entries.pop(key, None)
+
+    # -- queries --------------------------------------------------------
+    def _sampled(self):
+        """[(entry, bytes)] with dynamic entries sampled; failures are
+        swallowed (telemetry must never kill training)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        out = []
+        for e in entries:
+            b = e["bytes"]
+            if e["fn"] is not None:
+                try:
+                    b = int(e["fn"]() or 0)
+                except Exception:
+                    b = 0
+            out.append((e, b))
+        return out
+
+    def totals(self):
+        """{space: {category: bytes}} over the live entries."""
+        out = {SPACE_HBM: {}, SPACE_HOST: {}}
+        for e, b in self._sampled():
+            space = out.setdefault(e["space"], {})
+            space[e["category"]] = space.get(e["category"], 0) + b
+        return out
+
+    def top_buffers(self, n=8):
+        """The n largest live buffers, for the OOM dump."""
+        rows = sorted(self._sampled(), key=lambda t: -t[1])[:max(n, 0)]
+        return [{"category": e["category"], "name": e["name"],
+                 "space": e["space"], "bytes": b} for e, b in rows]
+
+    def set_plan(self, plan):
+        """Attach a per-component memory plan ({component: planned
+        bytes per device}, hbm space); `reconcile` reports
+        plan-vs-ledger deltas from then on."""
+        self._plan = dict(plan) if plan else None
+
+    @property
+    def plan(self):
+        return dict(self._plan) if self._plan else None
+
+    @property
+    def peak(self):
+        with self._lock:
+            return dict(self._peak) if self._peak else None
+
+    # -- fence reconciliation -------------------------------------------
+    def reconcile(self, device_stats=None, rss=None, step=None,
+                  top_n=8):
+        """Ledger vs measured at a fence. `device_stats` is the
+        `device_memory_stats()` dict (or None), `rss` the host RSS (or
+        None). Returns the JSON-able `memory` event payload; updates
+        the peak watermark WITH the attribution snapshot at the fence
+        that observed it. Pure host arithmetic — zero device syncs."""
+        totals = self.totals()
+        hbm_cats = totals.get(SPACE_HBM, {})
+        host_cats = totals.get(SPACE_HOST, {})
+        hbm_ledger = int(sum(hbm_cats.values()))
+        host_ledger = int(sum(host_cats.values()))
+
+        dev_count = int((device_stats or {}).get("device_count", 0))
+        in_use = (device_stats or {}).get("in_use_bytes")
+        dev_peak = (device_stats or {}).get("peak_bytes")
+        if not dev_count:
+            in_use = dev_peak = None
+        if rss is None:
+            rss = (device_stats or {}).get("host_rss_bytes")
+
+        # the ledger counts what ONE device holds; the allocator's
+        # in_use is summed over ALL local devices — compare in
+        # per-device terms or a D-device host inflates the residual by
+        # (D-1)x the ledger and every OOM hint blames activations
+        in_use_per_dev = None if in_use is None \
+            else int(in_use) // max(dev_count, 1)
+        payload = {
+            "schema": MEMORY_SCHEMA_VERSION,
+            "hbm": {
+                "categories": dict(hbm_cats),
+                "ledger_bytes": hbm_ledger,
+                "measured_in_use": None if in_use is None
+                else int(in_use),
+                "measured_in_use_per_device": in_use_per_dev,
+                "measured_peak": None if dev_peak is None
+                else int(dev_peak),
+                # residual = activations + XLA temporaries + allocator
+                # overhead: what one device's measured allocation holds
+                # beyond every registered long-lived buffer (per-device,
+                # like the ledger and the per-chip peak)
+                "residual_bytes": None if in_use_per_dev is None
+                else in_use_per_dev - hbm_ledger,
+                "device_count": dev_count,
+            },
+            "host": {
+                "categories": dict(host_cats),
+                "ledger_bytes": host_ledger,
+                "rss_bytes": None if rss is None else int(rss),
+                "residual_bytes": None if rss is None
+                else int(rss) - host_ledger,
+            },
+            "top_buffers": self.top_buffers(top_n),
+        }
+        # watermark: the binding pressure number is the allocator peak
+        # on-device; host RSS stands in off-TPU (device_count == 0)
+        watermark = dev_peak if dev_peak is not None else rss
+        if watermark is not None:
+            with self._lock:
+                if self._peak is None or \
+                        watermark > self._peak["bytes"]:
+                    self._peak = {
+                        "bytes": int(watermark),
+                        "space": SPACE_HBM if dev_peak is not None
+                        else SPACE_HOST,
+                        "step": step,
+                        "categories": dict(
+                            hbm_cats if dev_peak is not None
+                            else host_cats),
+                        "residual_bytes":
+                            payload["hbm"]["residual_bytes"]
+                            if dev_peak is not None
+                            else payload["host"]["residual_bytes"],
+                    }
+                peak = dict(self._peak)
+        else:
+            peak = self.peak
+        payload["peak"] = peak
+        if self._plan:
+            payload["plan"] = plan_vs_measured(self._plan, hbm_cats)
+        return payload
+
+
+# ----------------------------------------------------------------------
+# plan-vs-measured validation
+# ----------------------------------------------------------------------
+def plan_vs_measured(plan, measured_categories):
+    """Per-component deltas between a memory plan ({component:
+    planned bytes per device}) and measured/ledger category bytes.
+    delta_pct is signed relative to the plan; None planned-or-measured
+    components report a None delta rather than fabricating 0."""
+    out = {}
+    for comp in sorted(set(plan) | set(measured_categories)):
+        planned = plan.get(comp)
+        got = measured_categories.get(comp)
+        row = {"planned_bytes": None if planned is None
+               else int(planned),
+               "measured_bytes": None if got is None else int(got)}
+        if planned and got is not None:
+            row["delta_pct"] = round(
+                (got - planned) / planned * 100.0, 3)
+        else:
+            row["delta_pct"] = None
+        out[comp] = row
+    return out
+
+
+# ----------------------------------------------------------------------
+# OOM forensics
+# ----------------------------------------------------------------------
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "OUT OF MEMORY", "ALLOCATION FAILURE",
+                "FAILED TO ALLOCATE")
+# "OOM" needs word boundaries: "room"/"zoom"/"bloom" in an ordinary
+# error message must not trigger memory forensics
+_OOM_WORD = re.compile(r"\bOOM\b")
+
+
+def classify_oom(exc):
+    """True when an exception out of the step loop is an allocator
+    failure (XLA RESOURCE_EXHAUSTED, host MemoryError, or any error
+    whose message carries an out-of-memory marker). Classification is
+    textual by design: jaxlib's XlaRuntimeError carries the gRPC
+    status only in its message, and the flight path must not import
+    backend-specific exception types to read it."""
+    if isinstance(exc, MemoryError):
+        return True
+    try:
+        text = f"{type(exc).__name__}: {exc}".upper()
+    except Exception:
+        return False
+    return any(m in text for m in _OOM_MARKERS) or \
+        bool(_OOM_WORD.search(text))
+
+
+def oom_hints(payload):
+    """Actionable knobs ranked by what the reconciled payload says
+    actually dominates. Every hint names the config key to turn."""
+    hints = []
+    hbm = payload.get("hbm", {})
+    cats = hbm.get("categories", {})
+    ledger = hbm.get("ledger_bytes") or 0
+    # per-device, like the ledger and the residual
+    measured = hbm.get("measured_in_use_per_device")
+    residual = hbm.get("residual_bytes")
+    if measured and residual is not None and residual > 0.5 * measured:
+        hints.append(
+            "activations/XLA temporaries dominate (residual "
+            f"{residual / 2**30:.2f} GiB of {measured / 2**30:.2f} GiB "
+            "in use): tighten remat — set activation checkpointing / "
+            '"checkpoint_policy": "save_fused_epilogues" — or reduce '
+            "train_micro_batch_size_per_gpu")
+    if cats.get(CAT_CKPT):
+        hints.append(
+            "a checkpoint snapshot double-buffer was alive "
+            f"({cats[CAT_CKPT] / 2**30:.2f} GiB): lower "
+            "checkpoint.writer_queue_depth / keep_last, save less "
+            "often, or set checkpoint.async_save false (inline saves "
+            "skip the snapshot copy)")
+    if cats.get(CAT_PREFETCH) and ledger and \
+            cats[CAT_PREFETCH] > 0.1 * ledger:
+        hints.append(
+            "prefetch staging holds "
+            f"{cats[CAT_PREFETCH] / 2**30:.2f} GiB: reduce "
+            "async_dispatch.prefetch_depth")
+    state = (cats.get(CAT_MASTER, 0) + cats.get(CAT_OPT, 0) +
+             cats.get(CAT_GRADS, 0))
+    if ledger and state > 0.5 * ledger:
+        hints.append(
+            "optimizer state (master+moments+accumulator) is "
+            f"{state / 2**30:.2f} GiB of {ledger / 2**30:.2f} GiB "
+            "ledgered: raise zero_optimization.stage, or offload "
+            'masters to host ("cpu_offload": true)')
+    if not hints:
+        hints.append(
+            "no single ledger category dominates: compare the "
+            "per-category bytes in this dump against the memory plan "
+            "(ZeroShardingPolicy.memory_plan) to find what grew")
+    return hints

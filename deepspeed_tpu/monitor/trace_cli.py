@@ -9,6 +9,9 @@ into one file Perfetto opens as a multi-rank timeline. `summary`
 prints per-track busy/occupancy and — when pipeline events are present
 — the measured bubble fraction next to the schedule's analytic
 (p-1)/(v·m+p-1), the number the interleaved-1F1B work exists to move.
+When the trace carries the memory ledger's counter tracks it also
+prints per-category last/peak bytes and — when a memory plan rode in
+the trace metadata — the per-component plan-vs-measured deltas.
 """
 
 import argparse
@@ -65,8 +68,46 @@ def _print_summary(doc):
                   f"m={sched.get('micro_batches')} "
                   f"v={sched.get('num_virtual_stages')} "
                   f"ticks={sched.get('ticks')}")
-    if not tracks and not pipe:
+    mem = s.get("memory")
+    if mem:
+        _print_memory(mem)
+    if not tracks and not pipe and not mem:
         print("no complete events in trace")
+
+
+def _fmt_gib(b):
+    return f"{b / 2**30:.3f}"
+
+
+def _print_memory(mem):
+    """The memory ledger's counter tracks: final composition + peak
+    per category, and plan-vs-measured deltas when a memory plan rode
+    in the trace metadata."""
+    for series in ("hbm_bytes", "host_bytes"):
+        rows = mem.get(series)
+        if not rows:
+            continue
+        print(f"memory ({series.split('_')[0]}):")
+        width = max(len(k) for k in rows)
+        print(f"  {'category'.ljust(width)}   last_gib   peak_gib")
+        for name, r in rows.items():
+            print(f"  {name.ljust(width)}  {_fmt_gib(r['last_bytes']):>9}"
+                  f"  {_fmt_gib(r['peak_bytes']):>9}")
+    pvm = mem.get("plan_vs_measured")
+    if pvm:
+        print("memory plan vs measured (per-device, peak):")
+        width = max(len(k) for k in pvm)
+        print(f"  {'component'.ljust(width)}  planned_gib  "
+              "measured_gib  delta_pct")
+        for comp, r in pvm.items():
+            planned = "-" if r["planned_bytes"] is None else \
+                _fmt_gib(r["planned_bytes"])
+            got = "-" if r["measured_bytes"] is None else \
+                _fmt_gib(r["measured_bytes"])
+            delta = "-" if r["delta_pct"] is None else \
+                f"{r['delta_pct']:+.2f}"
+            print(f"  {comp.ljust(width)}  {planned:>11}  {got:>12}  "
+                  f"{delta:>9}")
 
 
 def main(argv=None):

@@ -92,6 +92,12 @@ class TraceExporter:
     # ------------------------------------------------------------------
     # track + event primitives
     # ------------------------------------------------------------------
+    def set_meta(self, **kv):
+        """Attach JSON-able metadata to the trace's otherData (e.g. a
+        memory plan for `ds_trace summary`'s plan-vs-measured)."""
+        with self._lock:
+            self._meta.update(kv)
+
     def _tid(self, track):
         tid = self._tracks.get(track)
         if tid is None:
@@ -236,16 +242,22 @@ def merge_traces(docs):
     events = []
     ranks = {}
     pipeline = None
+    memory_plan = None
     for doc in docs:
         events.extend(doc.get("traceEvents", []))
         other = doc.get("otherData", {}) or {}
         ranks[str(other.get("rank", len(ranks)))] = other
         pipeline = pipeline or other.get("pipeline")
+        memory_plan = memory_plan or other.get("memory_plan")
     events.sort(key=lambda e: (e.get("ph") != "M", e.get("ts", 0)))
     other = {"schema": TRACE_SCHEMA_VERSION, "merged_ranks": len(docs),
              "ranks": ranks}
     if pipeline:
         other["pipeline"] = pipeline
+    if memory_plan:
+        # promoted like `pipeline`: summary of a merged doc must keep
+        # plan-vs-measured working
+        other["memory_plan"] = memory_plan
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": other}
 
@@ -257,11 +269,25 @@ def summarize_trace(doc):
     tracks = {}      # (pid, tid) -> {"busy_us", "t0", "t1", "events"}
     names = {}
     pipe_busy = {}
+    mem_counters = {}   # series name -> {key: {"last", "peak"}}
     for ev in doc.get("traceEvents", []):
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
             names[(ev.get("pid"), ev.get("tid"))] = \
                 ev.get("args", {}).get("name")
+            continue
+        if ph == "C" and ev.get("name") in ("hbm_bytes", "host_bytes"):
+            # the memory ledger's per-category counter tracks, keyed
+            # per RANK (pid): events are ts-ordered within a rank, so
+            # "last wins" + running max give that rank's final
+            # composition and per-category peak — mixing ranks here
+            # would interleave unrelated series
+            series = mem_counters.setdefault(
+                (ev.get("pid"), ev["name"]), {})
+            for k, v in (ev.get("args") or {}).items():
+                row = series.setdefault(k, {"last": 0.0, "peak": 0.0})
+                row["last"] = float(v)
+                row["peak"] = max(row["peak"], float(v))
             continue
         if ph != "X":
             continue
@@ -320,4 +346,32 @@ def summarize_trace(doc):
                 k: analytic.get(k) for k in
                 ("stages", "micro_batches", "num_virtual_stages",
                  "ticks")}
+    if mem_counters:
+        # merge ranks by MAX: ledger values are per-device, so the
+        # cross-rank max is the binding pressure number (under SPMD
+        # the ranks are near-identical anyway); `ranks` says how many
+        # were merged so an asymmetric fleet is visible
+        merged = {}
+        pids = set()
+        for (pid, name), rows in mem_counters.items():
+            pids.add(pid)
+            series = merged.setdefault(name, {})
+            for k, v in rows.items():
+                row = series.setdefault(k, {"last": 0.0, "peak": 0.0})
+                row["last"] = max(row["last"], v["last"])
+                row["peak"] = max(row["peak"], v["peak"])
+        mem = {name: {k: {"last_bytes": int(v["last"]),
+                          "peak_bytes": int(v["peak"])}
+                      for k, v in sorted(rows.items())}
+               for name, rows in merged.items()}
+        if len(pids) > 1:
+            mem["ranks"] = len(pids)
+        plan = (doc.get("otherData", {}) or {}).get("memory_plan")
+        if plan:
+            from deepspeed_tpu.monitor.memory import plan_vs_measured
+            peaks = {k: v["peak_bytes"]
+                     for k, v in mem.get("hbm_bytes", {}).items()
+                     if k != "residual"}
+            mem["plan_vs_measured"] = plan_vs_measured(plan, peaks)
+        out["memory"] = mem
     return out

@@ -639,12 +639,16 @@ class AsyncCheckpointWriter:
                 self._commit_turn += 1
             self._commit_cv.notify_all()
 
-    def submit(self, fn, tag):
+    def submit(self, fn, tag, on_done=None):
         """Run fn(commit_gate) on a writer thread; `commit_gate` is a
         context manager the job must hold around its commit section
         (rename + `latest` + rotation) — gates open in submission
         order.  Returns True when the job was accepted, False when
-        queue_policy="drop" rejected it."""
+        queue_policy="drop" rejected it.  `on_done` (optional, must
+        not raise meaningfully) runs on the writer thread after the
+        job finishes — success OR failure — e.g. releasing the
+        snapshot's memory-ledger entries: the double-buffers are gone
+        once the writer is, however the write ended."""
         self._raise_pending()
         tag = str(tag)
         # two writers on one tag would share a `<tag>.tmp` staging dir
@@ -698,6 +702,11 @@ class AsyncCheckpointWriter:
                 # a job that died before (or without) taking its gate
                 # must still release its turn or later jobs deadlock
                 self._mark_done(seq)
+                if on_done is not None:
+                    try:
+                        on_done()
+                    except Exception:
+                        pass
 
         t = threading.Thread(target=run, daemon=False,
                              name=f"ckpt-writer-{tag}")

@@ -214,6 +214,24 @@ MONITOR_NUMERICS = "numerics"
 MONITOR_NUMERICS_ENABLED = "enabled"
 MONITOR_NUMERICS_ENABLED_DEFAULT = False
 
+# -- monitor.memory: live HBM/host byte ledger ------------------------
+#   {"memory": {"enabled": true, "top_buffers": 8}}
+# ON by default with the monitor (like flight): every long-lived
+# allocation site (engine state groups, offload host state, checkpoint
+# snapshot double-buffers, prefetch staging, pipe 1F1B buffers)
+# registers its logical bytes from shape metadata; each fence
+# reconciles ledger vs device_memory_stats + host RSS into a `memory`
+# event (residual = activations/XLA temporaries), tracks the peak
+# watermark with the attribution snapshot AT peak, and renders
+# Perfetto per-category counter tracks. RESOURCE_EXHAUSTED crashes get
+# the ledger + top buffers + actionable hints attached to the flight
+# dump. Zero new per-step host syncs (guard-tested).
+MONITOR_MEMORY = "memory"
+MONITOR_MEMORY_ENABLED = "enabled"
+MONITOR_MEMORY_ENABLED_DEFAULT = True
+MONITOR_MEMORY_TOP_BUFFERS = "top_buffers"
+MONITOR_MEMORY_TOP_BUFFERS_DEFAULT = 8
+
 #############################################
 # Progressive layer drop
 #############################################

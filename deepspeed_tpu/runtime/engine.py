@@ -837,6 +837,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 f"zero_stage={self.zero_optimization_stage()}, "
                 f"dtype={self.compute_dtype.__name__}, "
                 f"mesh={dict(self.mesh.shape)}", ranks=[0])
+            self._register_memory_ledger()
             self._initial_params = None   # don't pin the caller's copy
             return
 
@@ -920,7 +921,29 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.monitor.set_numerics_labels(
                 grad=_num.group_paths(self._params_enc_template),
                 act=self._act_layer_names)
+        self._register_memory_ledger()
         self._initial_params = None   # don't pin the caller's copy
+
+    def _register_memory_ledger(self):
+        """Register the engine's long-lived device state groups with
+        the monitor's memory ledger (monitor/memory.py). Init-time
+        shape/sharding metadata only — per-device bytes come from
+        `sharding.shard_shape`, so ZeRO-sharded groups register what
+        ONE device actually holds. Runs unconditionally (the ledger is
+        a dict; there is no per-step cost)."""
+        from deepspeed_tpu.monitor import memory as _mem
+        led = self.monitor.ledger
+        st = self.state
+        led.register_tree(_mem.CAT_PARAMS, "engine.params", st.params)
+        if st.master is not None:
+            led.register_tree(_mem.CAT_MASTER, "engine.master_fp32",
+                              st.master)
+        if st.opt_state:
+            led.register_tree(_mem.CAT_OPT, "engine.opt_state",
+                              st.opt_state)
+        if st.acc_grads:
+            led.register_tree(_mem.CAT_GRADS, "engine.acc_grads",
+                              st.acc_grads)
 
     def _count_model_params(self, tree):
         """Model parameter count for logs/profiling; engines whose
@@ -2180,11 +2203,59 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.wait_for_checkpoint()
             self._write_checkpoint(save_dir, str(tag), snap, save_latest)
             return True
-        return self._ckpt_writer.submit(
-            lambda commit_gate: self._write_checkpoint(
-                save_dir, str(tag), snap, save_latest,
-                commit_gate=commit_gate),
-            tag)
+        # memory ledger: the snapshot's fresh double-buffers are alive
+        # from here until the writer finishes (success or failure) —
+        # exactly the window an OOM post-mortem needs attributed
+        tokens = self._register_ckpt_snapshot(str(tag), snap)
+        led = self.monitor.ledger
+        try:
+            accepted = self._ckpt_writer.submit(
+                lambda commit_gate: self._write_checkpoint(
+                    save_dir, str(tag), snap, save_latest,
+                    commit_gate=commit_gate),
+                tag,
+                on_done=lambda: [led.release(t) for t in tokens])
+        except BaseException:
+            # submit re-raises pending writer errors BEFORE accepting
+            # the job — a leaked entry would pollute every later
+            # memory event with a phantom snapshot
+            for t in tokens:
+                led.release(t)
+            raise
+        if not accepted:
+            for t in tokens:
+                led.release(t)
+        return accepted
+
+    def _register_ckpt_snapshot(self, tag, snap):
+        """Register the isolated snapshot's copies with the memory
+        ledger: device payload buffers (per-device bytes) + the
+        offload host memcpys. Entry names carry a per-engine sequence
+        number — a re-save of the SAME tag while the first write is in
+        flight must not replace the first save's entries (whose
+        on_done would then release the live second snapshot). Returns
+        the tokens the writer's on_done releases."""
+        from deepspeed_tpu.monitor import memory as _mem
+        led = self.monitor.ledger
+        seq = self._ckpt_snap_seq = \
+            getattr(self, "_ckpt_snap_seq", 0) + 1
+        name = f"snapshot:{tag}@{seq}"
+        tokens = [led.register_tree(_mem.CAT_CKPT, name,
+                                    snap["payload"])]
+        host = 0
+        if "host_master" in snap:
+            host += int(snap["host_master"].nbytes)
+        for v in (snap.get("host_adam") or {}).values():
+            if isinstance(v, np.ndarray):
+                host += int(v.nbytes)
+        for v in (snap.get("offload_wire") or {}).values():
+            if isinstance(v, np.ndarray):
+                host += int(v.nbytes)
+        if host:
+            tokens.append(led.register(
+                _mem.CAT_CKPT, f"{name}#host", host,
+                space=_mem.SPACE_HOST))
+        return tokens
 
     def wait_for_checkpoint(self):
         """Barrier for in-flight async saves: returns once every

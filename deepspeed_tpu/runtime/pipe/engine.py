@@ -401,6 +401,19 @@ class PipelineEngine(DeepSpeedEngine):
             layout=getattr(self, "_pipe_layout", None),
             num_virtual_stages=v,
             chunk_parts=getattr(self, "_chunk_parts", None))
+        bm = getattr(self._interp_fn, "buffer_meta", None)
+        if bm:
+            # memory ledger: the executor's persistent per-stage carry
+            # (saved-input recompute buffers + delivery rings) — the
+            # 1F1B activation bound, attributed so an OOM dump can tell
+            # schedule memory from model state
+            from deepspeed_tpu.monitor import memory as _mem
+            self.monitor.ledger.register(
+                _mem.CAT_PIPE, "pipe.1f1b_buffers",
+                bm["bytes_per_stage"],
+                meta={k: bm[k] for k in
+                      ("saved_input_buffers", "channel_depth",
+                       "flat_width", "transport_dtype")})
         log_dist(
             f"PipelineEngine: compiled "
             f"{'interleaved ' if v > 1 else ''}1F1B schedule over "

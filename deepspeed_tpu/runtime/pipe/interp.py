@@ -743,4 +743,17 @@ def build_pipeline_step(module, mesh, micro_batches, params_example,
     step.clock_tables = export_tables
     step.pipe_meta = {"stages": S, "micro_batches": m,
                       "num_virtual_stages": v, "train": train}
+    # memory-ledger accounting of the executor's persistent per-stage
+    # carry: saved-input recompute buffers [B, A] + the two depth-C
+    # delivery rings + the fwd/bwd send registers, all in the flat
+    # transport dtype. Per DEVICE (each pipe shard carries its own).
+    _itemsize = jnp.dtype(tdt).itemsize
+    step.buffer_meta = {
+        "saved_input_buffers": int(B),
+        "channel_depth": int(C),
+        "flat_width": int(A),
+        "transport_dtype": str(jnp.dtype(tdt).name),
+        "bytes_per_stage": int(
+            (B + 2 * C + 2 if train else C + 1) * A * _itemsize),
+    }
     return step

@@ -71,6 +71,10 @@ class PrefetchLoader:
         self._heartbeat = heartbeat
         self._finished = finished
         self._span = span
+        # bytes of one staged batch (set by the worker after the first
+        # stage; shape metadata only) — the memory ledger's dynamic
+        # prefetch entry samples occupancy x this
+        self.staged_nbytes = 0
         self.depth = max(1, int(depth))
         self._queue = queue.Queue(maxsize=self.depth)
         self._exc = None
@@ -104,6 +108,13 @@ class PrefetchLoader:
                     break
                 if self._stage_fn is not None:
                     batch = self._stage_fn(batch)
+                if not self.staged_nbytes:
+                    try:
+                        from deepspeed_tpu.monitor.memory import \
+                            tree_nbytes
+                        self.staged_nbytes = tree_nbytes(batch)
+                    except Exception:
+                        pass
                 if self._span is not None:
                     try:
                         self._span(t0, time.perf_counter() - t0)
@@ -166,6 +177,14 @@ class PrefetchLoader:
         monitor's prefetch gauge: 0 at a fence means the input pipeline
         is the bottleneck; == depth means the step loop is)."""
         return self._queue.qsize()
+
+    def buffer_bytes(self):
+        """Device bytes held by queued staged batches right now
+        (occupancy x per-batch bytes) — the memory ledger's dynamic
+        prefetch entry. Plus one batch for the item the worker holds
+        between stage and put would overstate the steady state; the
+        queue is the bound that matters."""
+        return self._queue.qsize() * self.staged_nbytes
 
     def close(self):
         """Stop the worker and drop queued batches."""

@@ -112,6 +112,16 @@ class ZeroOffloadMixin:
             dynamic_scaling=self.dynamic_loss_scale_enabled,
             dynamic_loss_args=self.dynamic_loss_scale_args())
         self._init_offload_wire(int(flat.size))
+        # memory ledger: the offload design MOVES the master/optimizer
+        # state to host RAM — the ledger's host space is where ZeRO-
+        # Offload's whole memory argument lives, so register it there
+        from deepspeed_tpu.monitor import memory as _mem
+        led = self.monitor.ledger
+        led.register(_mem.CAT_HOST_MASTER, "offload.host_master",
+                     self._host_master.nbytes, space=_mem.SPACE_HOST)
+        # CPU-Adam moments: exp_avg + exp_avg_sq, fp32, one per element
+        led.register(_mem.CAT_HOST_OPT, "offload.adam_moments",
+                     2 * int(flat.size) * 4, space=_mem.SPACE_HOST)
         log_dist(
             f"ZeRO-Offload: {flat.size/1e6:.1f}M fp32 masters + moments "
             f"on host (native cpu_adam={self._host_adam.native}, "
@@ -155,12 +165,16 @@ class ZeroOffloadMixin:
         self._offload_grad_residual = None
         self._offload_param_shadow = None
         self._offload_device_flat = None
+        from deepspeed_tpu.monitor import memory as _mem
+        led = self.monitor.ledger
         if self._wire_grad_bits == 1:
             # error-feedback residual: device-resident, padded to a
             # whole number of scale blocks, same layout as the flat
             # grad wire it corrects
             n_pad = -(-n // B) * B
             self._offload_grad_residual = jnp.zeros((n_pad,), jnp.float32)
+            led.register(_mem.CAT_WIRE, "offload.grad_residual",
+                         self._offload_grad_residual.nbytes)
         if self._wire_param_bits == 8:
             # host shadow tracks the device fp32 flat copy (both apply
             # the SAME dequantized deltas; they agree to float rounding).
@@ -170,6 +184,14 @@ class ZeroOffloadMixin:
             self._offload_param_shadow = self._host_master.copy()
             self._offload_device_flat = jnp.array(self._host_master,
                                                   copy=True)
+            led.register(_mem.CAT_WIRE, "offload.param_shadow",
+                         self._offload_param_shadow.nbytes,
+                         space=_mem.SPACE_HOST)
+            # the persistent device fp32 flat copy IS the int8 wire's
+            # documented 4 B/param device cost — ledger it so an OOM
+            # dump can name it
+            led.register(_mem.CAT_WIRE, "offload.device_flat",
+                         self._offload_device_flat.nbytes)
 
     def _build_offload_fns(self):
         """Jitted halves of the offload step."""

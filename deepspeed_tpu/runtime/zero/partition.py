@@ -276,6 +276,64 @@ class ZeroShardingPolicy:
             return jax.lax.slice_in_dim(leaf, 0, true, axis=d)
         return self._tree_apply_plan(tree, plan, unpad, suffix_match)
 
+    # -- per-device byte accounting (memory ledger / plan validation) --
+    def _spec_fraction(self, spec):
+        """Fraction of a leaf ONE device holds under `spec` (1 / the
+        product of named-axis sizes; tuple entries like (model, data)
+        multiply). Pure metadata math — no arrays touched."""
+        frac = 1.0
+        for axis in spec:
+            if axis is None:
+                continue
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            for a in axes:
+                frac /= self.mesh.shape.get(a, 1)
+        return frac
+
+    def sharded_nbytes(self, tree, pspecs, bytes_per_elem):
+        """Per-device bytes of a state group: each leaf's element
+        count x bytes_per_elem x the fraction its PartitionSpec leaves
+        on one device. `tree` may be abstract (eval_shape output)."""
+        total = 0.0
+        for leaf, spec in zip(
+                jax.tree_util.tree_leaves(tree),
+                jax.tree_util.tree_leaves(
+                    pspecs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec))):
+            total += int(np.prod(np.shape(leaf))) * bytes_per_elem * \
+                self._spec_fraction(spec)
+        return int(total)
+
+    def memory_plan(self, shapes, compute_bytes=2, sr_mode=False,
+                    gas=1):
+        """Planned per-device bytes per memory-ledger category for a
+        parameter tree of `shapes` (abstract ok) under this policy:
+
+          params     compute-dtype params (sharded only at stage 3)
+          master     fp32 masters (absent in SR mode — no fp32 store)
+          opt_state  two Adam moments (fp32, or compute-dtype in SR
+                     mode), sharded like the masters
+          grads      the persistent fp32 accumulator (only when the
+                     fused step keeps one, i.e. gas > 1)
+
+        Uses the ENCODED (pad-plan) layout for the sharded groups —
+        the bytes the engine actually stores. This is the closed-form
+        the memory ledger and the 13B feasibility plan validate
+        against (`monitor/memory.py::plan_vs_measured`)."""
+        enc = self.encode(shapes, self.pad_plan(shapes))
+        plan = {
+            "params": self.sharded_nbytes(
+                shapes, self.param_pspecs(shapes), compute_bytes),
+            "master": 0 if sr_mode else self.sharded_nbytes(
+                enc, self.master_pspecs(enc), 4),
+            "opt_state": 2 * self.sharded_nbytes(
+                enc, self.master_pspecs(enc),
+                compute_bytes if sr_mode else 4),
+            "grads": self.sharded_nbytes(
+                enc, self.grad_accum_pspecs(enc), 4) if gas > 1 else 0,
+        }
+        return plan
+
     def opt_state_shardings(self, opt_state, params):
         """Optimizer state: leaves that mirror a param shape get that
         param's master sharding; everything else (counts, scalars) is

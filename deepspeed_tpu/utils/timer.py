@@ -17,7 +17,10 @@ def device_memory_stats():
     (the binding per-chip high-water mark; summing peaks would
     overstate a single chip's pressure). device_count=0 means the
     backend exposes no memory_stats (e.g. some CPU runtimes); the
-    monitor's memory gauge publishes the same three numbers."""
+    monitor's memory gauge publishes the same numbers. `host_rss_
+    bytes` (from /proc/self/statm, stdlib-only) rides along so the
+    gauge and the memory ledger's reconciliation stay meaningful
+    off-TPU, where the host RSS IS the run's memory signal."""
     in_use, peak, count = 0, 0, 0
     try:
         import jax
@@ -30,8 +33,13 @@ def device_memory_stats():
             count += 1
     except Exception:
         pass
-    return {"in_use_bytes": in_use, "peak_bytes": peak,
-            "device_count": count}
+    out = {"in_use_bytes": in_use, "peak_bytes": peak,
+           "device_count": count}
+    from deepspeed_tpu.monitor.memory import host_rss_bytes
+    rss = host_rss_bytes()
+    if rss is not None:
+        out["host_rss_bytes"] = rss
+    return out
 
 
 def _device_sync():

@@ -35,6 +35,7 @@ def test_bench_list_prints_legs():
     assert "async_checkpoint" in legs
     assert "fused_hot_loop" in legs and "pipe_interleave" in legs
     assert "monitor_overhead" in legs and "numerics_overhead" in legs
+    assert "memory_ledger" in legs
 
 
 def test_bench_only_fused_hot_loop_leg():
@@ -157,6 +158,35 @@ def test_bench_only_numerics_overhead_leg():
     assert result["jsonl_numerics_events"] > 0
     # a healthy run must not claim a NaN source
     assert result["first_nonfinite"] is None
+
+
+def test_bench_only_memory_ledger_leg():
+    """The memory-ledger plan-vs-measured leg (ISSUE 8) must run
+    end-to-end via `--only`: the 13B abstract plan agrees with the
+    closed form, the executed scaled run scores plan vs ledger vs
+    REAL per-device shard bytes, memory events flowed, and the
+    overhead A/B recorded its <3% contract flag (asserted here only
+    against a catastrophic bound — the numerics_overhead precedent
+    for shared-box noise)."""
+    proc = _bench_proc("--only", "memory_ledger", timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "memory_ledger"
+    result = d["result"]
+    assert "error" not in result, result
+    plan13 = result["plan_13b"]
+    assert plan13["params_b"] > 12
+    assert abs(plan13["vs_closed_form_pct"]) < 5.0
+    executed = result["executed"]
+    for scored in ("plan_vs_ledger", "plan_vs_measured"):
+        for comp in ("params", "opt_state"):
+            row = executed[scored][comp]
+            assert row["planned_bytes"] > 0
+            assert abs(row["delta_pct"]) < 15.0, (scored, comp, row)
+    assert executed["memory_events"] > 0
+    assert executed["ledger_event_plan"] is True
+    assert "regressed" in result
+    assert result["overhead_pct"] < 25.0, result
 
 
 def test_bench_only_unknown_leg_fails_with_list():

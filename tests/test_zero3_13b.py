@@ -92,6 +92,19 @@ def run_zero3_sr_memory_check(model_name, overrides, steps=2,
         f"{planned/2**30:.3f} GB (rel err {rel_err:.2%}) — state is "
         "replicating instead of sharding")
 
+    # memory-ledger cross-check (ISSUE 8): what the monitor's ledger
+    # registered from sharding metadata must agree with the MEASURED
+    # per-device shard bytes — the live validation the 13B memory
+    # plan's credibility rests on (the ledger registers even with the
+    # monitor disabled, so this big-model path always carries it)
+    cats = engine.monitor.ledger.totals()["hbm"]
+    ledgered = cats.get("params", 0) + cats.get("opt_state", 0)
+    led_err = abs(ledgered - measured) / measured
+    assert led_err < tolerance, (
+        f"ledger {ledgered/2**30:.3f} GB vs measured "
+        f"{measured/2**30:.3f} GB (rel err {led_err:.2%}) — the "
+        "ledger's shard arithmetic disagrees with the allocator")
+
     # real sharded update steps (grads = zeros generated inside jit)
     enc_template = engine._params_enc_template
 
@@ -115,6 +128,7 @@ def run_zero3_sr_memory_check(model_name, overrides, steps=2,
     return {"params_b": n_params / 1e9,
             "state_gb_per_device": measured / 2**30,
             "planned_gb_per_device": planned / 2**30,
+            "ledger_gb_per_device": ledgered / 2**30,
             "devices": n_dev}
 
 
