@@ -159,6 +159,16 @@ def feature_report():
                      "forensics (monitor.memory, default on)"))
     except Exception as e:
         rows.append(("memory ledger", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.runtime.zero.stage3 import \
+            Zero3GatherScheduler  # noqa: F401
+        rows.append((
+            "ZeRO-3 overlap",
+            f"{SUCCESS} layer-granular gather prefetch + "
+            "reduce-scatter grads (zero_optimization.stage3; GPT-2/"
+            "BERT stacks + sequential pipe chains)"))
+    except Exception as e:
+        rows.append(("ZeRO-3 overlap", f"{FAIL} {e}"))
 
     print("-" * 64)
     print("runtime feature report")

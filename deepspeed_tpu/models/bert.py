@@ -105,6 +105,28 @@ def _ds_layer_config(cfg: BertConfig) -> DeepSpeedTransformerConfig:
         training=True)
 
 
+def additive_attention_mask(attention_mask):
+    """[B, T] 1/0 -> additive [B, 1, 1, T] (None passes through).
+    The ONE definition of BERT's mask arithmetic — shared by the
+    module path and the ZeRO-3 scheduled path so they cannot drift."""
+    if attention_mask is None:
+        return None
+    mask = (1.0 - attention_mask.astype(jnp.float32)) * -1e9
+    return mask[:, None, None, :]
+
+
+def mlm_head_dtype(cfg: BertConfig):
+    """Resolve mlm_head_in_compute_dtype ("auto" = real TPU only) to
+    the dtype the head matmuls run in — shared by both apply paths."""
+    head_compute = cfg.mlm_head_in_compute_dtype
+    if head_compute == "auto":
+        head_compute = jax.default_backend() == "tpu"
+    if not head_compute:
+        return jnp.float32
+    return (jnp.float16 if cfg.fp16 else
+            jnp.bfloat16 if cfg.bf16 else jnp.float32)
+
+
 class BertEmbeddings(nn.Module):
     config: BertConfig
 
@@ -167,12 +189,7 @@ class BertModel(nn.Module):
         cfg = self.config
         h = BertEmbeddings(cfg, name="embeddings")(
             input_ids, token_type_ids, deterministic)
-        additive_mask = None
-        if attention_mask is not None:
-            # [B, T] 1/0 -> additive [B, 1, 1, T]
-            additive_mask = (1.0 - attention_mask.astype(jnp.float32)) * \
-                -1e9
-            additive_mask = additive_mask[:, None, None, :]
+        additive_mask = additive_attention_mask(attention_mask)
         h = BertEncoder(cfg, name="encoder")(h, additive_mask,
                                              deterministic)
         # pooler: tanh(dense(CLS))
@@ -198,13 +215,7 @@ class BertForPreTraining(nn.Module):
         # ~10% of the step's flops and in fp32 it was the top
         # per-fusion time sink. LN stats stay fp32; the loss upcasts
         # logits to fp32.
-        head_compute = cfg.mlm_head_in_compute_dtype
-        if head_compute == "auto":
-            head_compute = jax.default_backend() == "tpu"
-        head_dtype = jnp.float32
-        if head_compute:
-            head_dtype = (jnp.float16 if cfg.fp16 else
-                          jnp.bfloat16 if cfg.bf16 else jnp.float32)
+        head_dtype = mlm_head_dtype(cfg)
         x = nn.Dense(cfg.hidden_size, dtype=head_dtype, name="transform")(
             sequence_output.astype(head_dtype))
         x = nn.gelu(x, approximate=False)
@@ -235,6 +246,15 @@ class BertForPreTrainingLM:
     def __init__(self, config: BertConfig):
         self.config = config
         self.module = BertForPreTraining(config)
+        # ZeRO-3 gather/release scheduler (runtime/zero/stage3.py),
+        # bound by the engine when the effective zero stage is 3
+        self._zero3 = None
+
+    def bind_zero3_scheduler(self, sched):
+        """Engine hook: weave (or unweave, sched=None) the explicit
+        stage-3 gather scheduler through the loss path. The parameter
+        tree is IDENTICAL either way — checkpoints interchange."""
+        self._zero3 = sched
 
     def init(self, rng, example_batch):
         ids = example_batch["input_ids"]
@@ -242,16 +262,102 @@ class BertForPreTrainingLM:
             {"params": rng, "dropout": rng}, ids, deterministic=True)
         return variables["params"]
 
+    _zero3_dropout_warned = False
+
+    def _zero3_active(self, deterministic):
+        """Scheduled-path gate: dropout-active traces stay on the
+        module path — the scheduled stack folds its own per-layer rng
+        stream, which would change dropout masks vs the module path
+        (the fused_ops "auto = dropout-inactive" convention)."""
+        if self._zero3 is None:
+            return False
+        cfg = self.config
+        if deterministic or (cfg.hidden_dropout_prob == 0.0 and
+                             cfg.attention_probs_dropout_prob == 0.0):
+            return True
+        if not BertForPreTrainingLM._zero3_dropout_warned:
+            BertForPreTrainingLM._zero3_dropout_warned = True
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "ZeRO-3 gather scheduler: dropout is active, so this "
+                "trace uses the module path (implicit GSPMD gathers); "
+                "set the dropout probs to 0.0 for the scheduled "
+                "gather/release path in training")
+        return False
+
     def loss_fn(self, params, batch, rngs=None, deterministic=False, **_):
-        mlm_logits, nsp_logits = self.module.apply(
-            {"params": params}, batch["input_ids"],
-            batch.get("attention_mask"), batch.get("token_type_ids"),
-            deterministic, rngs=rngs or {})
+        if self._zero3_active(deterministic):
+            mlm_logits, nsp_logits = self._zero3_forward(
+                params, batch, rngs, deterministic)
+        else:
+            mlm_logits, nsp_logits = self.module.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("attention_mask"), batch.get("token_type_ids"),
+                deterministic, rngs=rngs or {})
         loss = _cross_entropy(mlm_logits, batch["masked_lm_labels"])
         if "next_sentence_label" in batch:
             loss = loss + _cross_entropy(nsp_logits,
                                          batch["next_sentence_label"])
         return loss
+
+    def _zero3_forward(self, params, batch, rngs, deterministic):
+        """Scheduled stage-3 forward: the encoder's stacked [L, ...]
+        DeepSpeedTransformerLayer params run under the gather/prefetch/
+        release schedule (attention mask threads through as a
+        non-differentiable broadcast input); embeddings/pooler/heads
+        gather once for the step. Same math as the module path."""
+        cfg = self.config
+        sched = self._zero3
+        rngs = rngs or {}
+        ids = batch["input_ids"]
+        attention_mask = batch.get("attention_mask")
+        token_type_ids = batch.get("token_type_ids")
+        bert_p = params["bert"]
+        # dropout-inactive by the _zero3_active gate
+        h = BertEmbeddings(cfg).apply(
+            {"params": sched.gather(bert_p["embeddings"],
+                                    name="bert.embeddings")},
+            ids, token_type_ids, deterministic, rngs=rngs)
+        additive_mask = additive_attention_mask(attention_mask)
+
+        (_, stacked), = bert_p["encoder"]["layer"].items()
+        ds_cfg = _ds_layer_config(cfg)
+        layer = DeepSpeedTransformerLayer(ds_cfg)
+
+        def body(lp, x, rng_k, *extra):
+            mask = extra[0] if extra else None
+            out = layer.apply({"params": lp}, x, mask, deterministic)
+            # dtype-stable carry, like the nn.scan cell: the fused
+            # layer's residual/LN path is fp32 while the carry may not be
+            return out.astype(x.dtype)
+
+        base_rng = rngs.get("dropout", jax.random.PRNGKey(0))
+        extra = () if additive_mask is None else (additive_mask,)
+        h = sched.apply_layers(body, stacked, h, base_rng, extra=extra,
+                               name="bert.encoder")
+
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size).apply(
+            {"params": sched.gather(bert_p["pooler"],
+                                    name="bert.pooler")},
+            h[:, 0].astype(jnp.float32)))
+
+        head_dtype = mlm_head_dtype(cfg)
+        x = nn.Dense(cfg.hidden_size, dtype=head_dtype).apply(
+            {"params": sched.gather(params["transform"],
+                                    name="transform")},
+            h.astype(head_dtype))
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                         dtype=jnp.float32).apply(
+            {"params": sched.gather(params["transform_ln"],
+                                    name="transform_ln")}, x)
+        mlm_logits = nn.Dense(cfg.vocab_size, dtype=head_dtype).apply(
+            {"params": sched.gather(params["decoder"], name="decoder")},
+            x.astype(head_dtype))
+        nsp_logits = nn.Dense(2).apply(
+            {"params": sched.gather(params["seq_relationship"],
+                                    name="seq_relationship")}, pooled)
+        return mlm_logits, nsp_logits
 
 
 def tiny_bert_config(**overrides):

@@ -269,6 +269,15 @@ class GPT2Block(nn.Module):
         return hidden + y
 
 
+def embed_tokens(cfg: GPT2Config, wte, wpe, input_ids):
+    """Token + position embedding in the compute dtype — the ONE
+    definition of GPT-2's embedding arithmetic, shared by the module
+    path and the ZeRO-3 scheduled path so they cannot drift."""
+    t = input_ids.shape[1]
+    return wte[input_ids].astype(cfg.dtype) + \
+        wpe[:t][None, :, :].astype(cfg.dtype)
+
+
 class GPT2LMHeadModel(nn.Module):
     """GPT-2 with tied-embedding LM head; returns logits."""
     config: GPT2Config
@@ -287,8 +296,7 @@ class GPT2LMHeadModel(nn.Module):
                          nn.initializers.normal(cfg.initializer_range),
                          (cfg.n_positions, cfg.n_embd), cfg.param_dtype)
 
-        hidden = wte[input_ids].astype(cfg.dtype) + \
-            wpe[:t][None, :, :].astype(cfg.dtype)
+        hidden = embed_tokens(cfg, wte, wpe, input_ids)
         hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
 
         # Scan one block over a stacked [n_layer, ...] param tree: single
@@ -410,6 +418,15 @@ class GPT2ForCausalLM:
     def __init__(self, config: GPT2Config):
         self.config = config
         self.module = GPT2LMHeadModel(config)
+        # ZeRO-3 gather/release scheduler (runtime/zero/stage3.py),
+        # bound by the engine when the effective zero stage is 3
+        self._zero3 = None
+
+    def bind_zero3_scheduler(self, sched):
+        """Engine hook: weave (or unweave, sched=None) the explicit
+        stage-3 gather scheduler through the loss path. The parameter
+        tree is IDENTICAL either way — checkpoints interchange."""
+        self._zero3 = sched
 
     def init(self, rng, example_batch):
         input_ids = example_batch["input_ids"]
@@ -417,14 +434,46 @@ class GPT2ForCausalLM:
                                      input_ids, True)
         return variables["params"]
 
-    def loss_fn(self, params, batch, rngs=None, deterministic=False,
-                layer_keep_prob=None):
+    @staticmethod
+    def _shifted_labels(batch):
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.concatenate(
                 [input_ids[:, 1:],
                  jnp.full_like(input_ids[:, :1], -100)], axis=1)
+        return input_ids, labels
+
+    _zero3_dropout_warned = False
+
+    def _zero3_active(self, deterministic):
+        """Scheduled-path gate: dropout-active traces stay on the
+        module path — the scheduled stack folds its own per-layer rng
+        stream, which would silently change dropout masks vs the
+        module path (and false-alarm an ABCorrectnessChecker A/B).
+        The fused_ops/head_packing "auto = dropout-inactive"
+        convention, applied to the gather schedule."""
+        if self._zero3 is None:
+            return False
+        if deterministic or self.config.dropout == 0.0:
+            return True
+        if not GPT2ForCausalLM._zero3_dropout_warned:
+            GPT2ForCausalLM._zero3_dropout_warned = True
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                "ZeRO-3 gather scheduler: dropout is active, so this "
+                "trace uses the module path (implicit GSPMD gathers) "
+                "to keep dropout streams identical to the unscheduled "
+                "engine; set dropout=0.0 to get the scheduled "
+                "gather/release path for training")
+        return False
+
+    def loss_fn(self, params, batch, rngs=None, deterministic=False,
+                layer_keep_prob=None):
+        if self._zero3_active(deterministic):
+            return self._zero3_loss(params, batch, rngs, deterministic,
+                                    layer_keep_prob)
+        input_ids, labels = self._shifted_labels(batch)
         kwargs = {}
         if layer_keep_prob is not None:
             kwargs["layer_keep_prob"] = layer_keep_prob
@@ -433,6 +482,53 @@ class GPT2ForCausalLM:
                                         rngs=rngs or {},
                                         return_hidden=True, **kwargs)
         return chunked_tied_head_loss(hidden, wte, labels)
+
+    def _zero3_loss(self, params, batch, rngs, deterministic,
+                    layer_keep_prob):
+        """The scheduled stage-3 forward: same math as the module path
+        (bit-exact at gather_dtype=None), but every parameter use goes
+        through the scheduler — embeddings/ln_f gathered once for the
+        step, the block stack driven by `apply_layers` so layer k+1's
+        all-gather issues while layer k computes and each gathered
+        buffer dies after its fwd/bwd use (full-block remat; the
+        backward re-gathers in reverse order and reduce-scatters each
+        layer's grad into its owning data-axis shard)."""
+        if layer_keep_prob is not None:
+            raise ValueError(
+                "progressive_layer_drop is not supported on the ZeRO-3 "
+                "scheduled path (the engine disables the scheduler "
+                "when PLD is configured)")
+        cfg = self.config
+        sched = self._zero3
+        input_ids, labels = self._shifted_labels(batch)
+        # dropout-inactive by the _zero3_active gate: every dropout
+        # layer is a no-op here, so no rng stream can diverge from the
+        # module path
+        wte = sched.gather(params["wte"], name="wte")
+        wpe = sched.gather(params["wpe"], name="wpe")
+        hidden = embed_tokens(cfg, wte, wpe, input_ids)
+
+        # the nn.scan cell's stacked [L, ...] params sit under the
+        # single auto-named child of "h" (GPT2Block_0 /
+        # CheckpointGPT2Block_0 under remat — same leaves either way)
+        (_, stacked), = params["h"].items()
+        block = GPT2Block(cfg)
+
+        def body(lp, h, rng_k):
+            return block.apply({"params": lp}, h, deterministic)
+
+        base_rng = (rngs or {}).get("dropout", jax.random.PRNGKey(0))
+        hidden = sched.apply_layers(body, stacked, hidden, base_rng,
+                                    name="h")
+
+        ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                            dtype=jnp.float32,
+                            param_dtype=cfg.param_dtype)
+        hidden = ln_f.apply(
+            {"params": sched.gather(params["ln_f"], name="ln_f")},
+            hidden)
+        return chunked_tied_head_loss(hidden.astype(cfg.dtype), wte,
+                                      labels)
 
     def apply(self, params, input_ids, deterministic=True):
         return self.module.apply({"params": params}, input_ids, deterministic)

@@ -11,6 +11,9 @@ pure index math — NO device sync anywhere in this module):
   master          device fp32 master copies (mixed precision)
   opt_state       optimizer moments (device)
   grads           the persistent fp32 grad accumulator (gas > 1)
+  zero3_gather    the stage-3 scheduler's live gathered-param window —
+                  (prefetch_layers + 1) layers of full params (a
+                  DYNAMIC entry; runtime/zero/stage3.py)
   host_master     ZeRO-Offload fp32 masters in host RAM
   host_opt_state  ZeRO-Offload CPU-Adam moments in host RAM
   wire            compressed-wire state: device residual / device flat
@@ -53,6 +56,7 @@ CAT_PARAMS = "params"
 CAT_MASTER = "master"
 CAT_OPT = "opt_state"
 CAT_GRADS = "grads"
+CAT_ZERO3 = "zero3_gather"
 CAT_HOST_MASTER = "host_master"
 CAT_HOST_OPT = "host_opt_state"
 CAT_WIRE = "wire"
@@ -61,8 +65,10 @@ CAT_PREFETCH = "prefetch"
 CAT_PIPE = "pipe_buffers"
 
 # canonical ordering for stacked rendering (Perfetto counter tracks,
-# event dicts): state groups first, transients last
-CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS,
+# event dicts): state groups first, transients last (zero3_gather —
+# the stage-3 scheduler's live gathered-param prefetch window — sits
+# with the state groups: it is persistent working memory of the step)
+CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS, CAT_ZERO3,
               CAT_HOST_MASTER, CAT_HOST_OPT, CAT_WIRE, CAT_CKPT,
               CAT_PREFETCH, CAT_PIPE)
 
@@ -391,6 +397,15 @@ def oom_hints(payload):
             "prefetch staging holds "
             f"{cats[CAT_PREFETCH] / 2**30:.2f} GiB: reduce "
             "async_dispatch.prefetch_depth")
+    if cats.get(CAT_ZERO3) and ledger and \
+            cats[CAT_ZERO3] > 0.15 * ledger:
+        hints.append(
+            "the ZeRO-3 gathered-param prefetch window holds "
+            f"{cats[CAT_ZERO3] / 2**30:.2f} GiB: lower "
+            "zero_optimization.stage3.prefetch_layers (live full-param "
+            "bytes scale with prefetch_layers + 1), or set "
+            "stage3.release_after_use true if the naive up-front "
+            "gather mode is on")
     state = (cats.get(CAT_MASTER, 0) + cats.get(CAT_OPT, 0) +
              cats.get(CAT_GRADS, 0))
     if ledger and state > 0.5 * ledger:

@@ -393,3 +393,33 @@ OFFLOAD_WIRE_WARMUP_STEPS = "warmup_steps"
 OFFLOAD_WIRE_WARMUP_STEPS_DEFAULT = 0
 OFFLOAD_WIRE_GRAD_BITS_VALID = (1, 8, 16, 32)
 OFFLOAD_WIRE_PARAM_BITS_VALID = (8, 32)
+
+#############################################
+# ZeRO stage-3 runtime (TPU-native extension): the explicit
+# gather/release scheduler for sharded compute params
+# (runtime/zero/stage3.py), configured under zero_optimization.stage3:
+#   {"stage3": {"prefetch_layers": 1, "release_after_use": true,
+#               "gather_dtype": null}}
+# enabled: weave the scheduler through supporting model apply paths
+#   (GPT-2/BERT layer stacks, sequential PipelineModule chains); off =
+#   params stay sharded with XLA-implicit gathers (no scheduling
+#   control, no live-bytes bound).
+# prefetch_layers: all-gathers issued ahead of use — layer k+N's
+#   params gather while layer k computes; live full-param memory is
+#   bounded by (prefetch_layers + 1) layers. 0 = gather at use.
+# release_after_use: false = naive baseline (whole stack gathered up
+#   front, held live through fwd+bwd; full stacked grad materializes
+#   before one bulk reduce-scatter) — the zero3_overlap bench A/B leg.
+# gather_dtype: cast params to this dtype BEFORE the all-gather
+#   (null = storage dtype; "bf16" halves gather bytes for fp32 params).
+#############################################
+STAGE3 = "stage3"
+STAGE3_ENABLED = "enabled"
+STAGE3_ENABLED_DEFAULT = True
+STAGE3_PREFETCH_LAYERS = "prefetch_layers"
+STAGE3_PREFETCH_LAYERS_DEFAULT = 1
+STAGE3_RELEASE_AFTER_USE = "release_after_use"
+STAGE3_RELEASE_AFTER_USE_DEFAULT = True
+STAGE3_GATHER_DTYPE = "gather_dtype"
+STAGE3_GATHER_DTYPE_DEFAULT = None
+STAGE3_GATHER_DTYPE_VALID = (None, "fp32", "bf16", "fp16")

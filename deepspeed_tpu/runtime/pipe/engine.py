@@ -154,6 +154,11 @@ class PipelineEngine(DeepSpeedEngine):
             self._pipe_flat_mode = (
                 self.mesh.shape[PIPE_AXIS] > 1 and
                 self.gradient_accumulation_steps() > 1)
+            # the sequential (pipe=1) chain applies layers one at a
+            # time — exactly the seam the ZeRO-3 gather scheduler
+            # needs; flat 1F1B mode caps the stage at 2 instead (the
+            # pipe axis already partitions parameters)
+            self._zero3_chain_capable = not self._pipe_flat_mode
             self._pipe_virtual_stages = 1
             self._chunk_parts = None
             v_cfg = self._virtual_stages_config()
@@ -244,6 +249,17 @@ class PipelineEngine(DeepSpeedEngine):
                 inputs, labels = _split_batch(batch)
                 x = inputs
                 stats = [] if collect else None
+                # ZeRO-3 runtime on the unrolled chain: each layer's
+                # sharded params all-gather through the scheduler, with
+                # an optimization_barrier tying layer idx's gather to
+                # the activation entering layer idx - prefetch_layers —
+                # without the fence XLA may hoist every gather to the
+                # top of the program (the naive up-front pattern);
+                # backward reduce-scatters each layer's grad into its
+                # owning shard via the gather's custom VJP
+                sched = getattr(self, "zero3_scheduler", None)
+                acts = [x]
+                chain_bytes = []
                 for idx in range(len(model.layers)):
                     kw = {}
                     if idx in det_accepting:
@@ -254,15 +270,41 @@ class PipelineEngine(DeepSpeedEngine):
                         # engine.py:809-810 inherits through the pipe
                         # engine's forward)
                         kw["layer_keep_prob"] = layer_keep_prob
-                    x = model.apply_layer(
-                        idx, model.layer_params(params, idx), x, rngs=rngs,
-                        **kw)
+                    lp = model.layer_params(params, idx)
+                    if sched is not None:
+                        chain_bytes.append(sched.tree_gathered_nbytes(lp))
+                        dep = acts[max(0, idx - sched.prefetch_layers)] \
+                            if sched.release_after_use else None
+
+                        def layer_call(lp_sharded, x, *, _idx=idx,
+                                       _dep=dep, _kw=kw):
+                            full = sched.gather(lp_sharded, depend=_dep)
+                            return model.apply_layer(_idx, full, x,
+                                                     rngs=rngs, **_kw)
+                        if sched.release_after_use:
+                            # remat the gather INSIDE the layer: the
+                            # gathered copy would otherwise be an
+                            # autodiff residual held from forward use
+                            # until this layer's backward — O(L) live
+                            # layers, not the window. Rematted, the
+                            # residual is the SHARDED lp; backward
+                            # re-gathers in reverse order, same as
+                            # apply_layers' hand-written scan.
+                            layer_call = jax.checkpoint(
+                                layer_call, prevent_cse=False)
+                        x = layer_call(lp, x)
+                        acts.append(x)
+                    else:
+                        x = model.apply_layer(idx, lp, x, rngs=rngs,
+                                              **kw)
                     if collect:
                         # numerics health: boundary stats AFTER layer
                         # idx — a finite input with a nonfinite output
                         # names the first-NaN layer
                         from deepspeed_tpu.monitor import numerics as nm
                         stats.append(nm.tensor_stats(x))
+                if sched is not None:
+                    sched.account_chain("pipe_chain", chain_bytes)
                 if model.loss_fn is not None:
                     x = model.loss_fn(x, labels)
                 if collect:

@@ -74,6 +74,10 @@ class DeepSpeedZeroConfig:
         self.offload_wire_grad_bits = None
         self.offload_wire_param_bits = None
         self.offload_wire_warmup_steps = None
+        self.stage3_enabled = None
+        self.stage3_prefetch_layers = None
+        self.stage3_release_after_use = None
+        self.stage3_gather_dtype = None
 
         if ZERO_OPTIMIZATION in param_dict:
             zero_config_dict = param_dict[ZERO_OPTIMIZATION]
@@ -119,6 +123,7 @@ class DeepSpeedZeroConfig:
             d, ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT,
             ZERO_OPTIMIZATION_ELASTIC_CHECKPOINT_DEFAULT)
         self._initialize_offload_wire(d.get(C.OFFLOAD_WIRE) or {})
+        self._initialize_stage3(d.get(C.STAGE3) or {})
 
     def _initialize_offload_wire(self, w):
         """zero_optimization.offload_wire: compressed wire format for the
@@ -148,6 +153,33 @@ class DeepSpeedZeroConfig:
         assert self.offload_wire_warmup_steps >= 0, (
             f"{C.OFFLOAD_WIRE}.{C.OFFLOAD_WIRE_WARMUP_STEPS} must be >= 0")
 
+    def _initialize_stage3(self, s):
+        """zero_optimization.stage3: knobs of the explicit stage-3
+        gather/release runtime (runtime/zero/stage3.py). Validation
+        raises ValueError with the offending value — a bare assert
+        would vanish under `python -O` and let a bad config train."""
+        if not isinstance(s, dict):
+            raise ValueError(
+                f"zero_optimization.{C.STAGE3} must be a dict, got {s!r}")
+        self.stage3_enabled = bool(get_scalar_param(
+            s, C.STAGE3_ENABLED, C.STAGE3_ENABLED_DEFAULT))
+        self.stage3_prefetch_layers = int(get_scalar_param(
+            s, C.STAGE3_PREFETCH_LAYERS, C.STAGE3_PREFETCH_LAYERS_DEFAULT))
+        if self.stage3_prefetch_layers < 0:
+            raise ValueError(
+                f"zero_optimization.{C.STAGE3}.{C.STAGE3_PREFETCH_LAYERS} "
+                f"must be >= 0, got {self.stage3_prefetch_layers}")
+        self.stage3_release_after_use = bool(get_scalar_param(
+            s, C.STAGE3_RELEASE_AFTER_USE,
+            C.STAGE3_RELEASE_AFTER_USE_DEFAULT))
+        self.stage3_gather_dtype = get_scalar_param(
+            s, C.STAGE3_GATHER_DTYPE, C.STAGE3_GATHER_DTYPE_DEFAULT)
+        if self.stage3_gather_dtype not in C.STAGE3_GATHER_DTYPE_VALID:
+            raise ValueError(
+                f"zero_optimization.{C.STAGE3}.{C.STAGE3_GATHER_DTYPE} "
+                f"must be one of {C.STAGE3_GATHER_DTYPE_VALID}, got "
+                f"{self.stage3_gather_dtype!r}")
+
     def offload_wire_compressed(self):
         """True when any leg of the wire differs from the legacy format."""
         return (self.offload_wire_grad_bits != 32 or
@@ -167,7 +199,12 @@ class DeepSpeedZeroConfig:
                     offload_wire=dict(
                         grad_bits=self.offload_wire_grad_bits,
                         param_bits=self.offload_wire_param_bits,
-                        warmup_steps=self.offload_wire_warmup_steps))
+                        warmup_steps=self.offload_wire_warmup_steps),
+                    stage3=dict(
+                        enabled=self.stage3_enabled,
+                        prefetch_layers=self.stage3_prefetch_layers,
+                        release_after_use=self.stage3_release_after_use,
+                        gather_dtype=self.stage3_gather_dtype))
 
     def __repr__(self):
         return str(self.repr())

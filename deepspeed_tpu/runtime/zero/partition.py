@@ -78,7 +78,13 @@ class ZeroShardingPolicy:
     """
 
     def __init__(self, mesh: Mesh, stage: int, param_specs=None):
-        assert 0 <= stage <= 3
+        # ValueError, not assert: a bad stage must fail loudly under
+        # `python -O` too, and the message must carry the value
+        if not isinstance(stage, (int, np.integer)) or \
+                not 0 <= stage <= 3:
+            raise ValueError(
+                f"zero_optimization.stage must be an integer in "
+                f"[0, 3], got {stage!r}")
         self.mesh = mesh
         self.stage = stage
         self.dp_size = mesh.shape[DATA_AXIS]
