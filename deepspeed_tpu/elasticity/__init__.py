@@ -209,6 +209,19 @@ def ensure_immutable_elastic_config(runtime_elastic_config_dict: dict):
                 f"{getattr(runtime, field)}")
 
 
+def __getattr__(name):
+    # Lazy re-export of the elastic runtime (elasticity/runtime.py):
+    # this package is imported by config parsing on paths that must
+    # not pull in jax/engine machinery.
+    if name in ("ElasticSupervisor", "FaultInjector", "FaultEvent",
+                "BatchSpec", "ElasticRuntimeConfig",
+                "LossContinuityError", "classify_failure"):
+        from deepspeed_tpu.elasticity import runtime as _rt
+        return getattr(_rt, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
 def compute_elastic_config(ds_config: dict, target_deepspeed_version: str,
                            world_size=0):
     """Compute (final_batch_size, valid_gpus[, micro_batch_size]).

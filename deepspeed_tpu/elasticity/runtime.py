@@ -1,0 +1,825 @@
+"""Elastic preemption-safe training runtime (ISSUE 10).
+
+The reference ships elasticity v0.1 as scheduling-time config math
+only — recovery is "restart the job and reload". This module turns the
+pieces this repo already built (crash-atomic async checkpoints, the
+stall watchdog + flight recorder, ZeRO re-planning, the
+resharding-tolerant checkpoint reload) into an actual recovery loop:
+
+  * `FaultInjector` — the chaos harness: spawns a sentinel subprocess
+    per virtual "host" (its liveness IS the host's), SIGKILLs them,
+    marks device groups lost/slow on the virtual mesh, injects stalls,
+    and returns capacity. `poll()` turns dead sentinels into
+    `host_lost` events.
+  * `classify_failure` — the failure taxonomy: lost host > slow host >
+    escalated stall > transient stall, with escalation after N
+    consecutive stall fires (mirroring the watchdog's own
+    `escalate_after`).
+  * `ElasticSupervisor` — owns a train loop end-to-end. Healthy path:
+    deterministic batches via `batch_fn(step, spec)`, periodic async
+    checkpoints. On a terminal failure it executes recovery:
+
+      1. drain — or, past `drain_timeout_sec`, ABANDON — in-flight
+         checkpoint writers (`engine.shutdown`);
+      2. pick the newest COMMITTED tag (`read_latest_tag` with bounded
+         retries; `latest` only ever names committed saves);
+      3. re-form the mesh on the surviving devices, truncated to the
+         largest device count `compute_elastic_config` declares valid,
+         with the micro-batch re-derived for that count (total batch
+         size is invariant across re-forms — the elastic contract);
+      4. re-plan ZeRO partitions for the new world size (the rebuilt
+         engine's `ZeroShardingPolicy`; the per-category plan bytes
+         ride the recovery event);
+      5. rebuild the engine and re-shard the checkpoint state onto the
+         new mesh (the reload-at-different-settings path: leaves
+         reassemble per-leaf and re-place under the new sharding);
+      6. resume, asserting loss continuity: every replayed step's loss
+         must match the pre-failure history within
+         `loss_continuity_atol` (bit-identical when the world size is
+         unchanged; reduction-order roundoff otherwise).
+
+    Scale-up is scheduled, not immediate: a `capacity_returned` event
+    marks the host available and the supervisor grows the mesh at the
+    next checkpoint boundary (after the save commits), so growing
+    never costs unsaved work.
+
+Config block (inside "elasticity"):
+
+    "elasticity": {
+      "enabled": true,
+      "max_train_batch_size": 48,
+      "micro_batch_sizes": [2],
+      "runtime": {
+        "enabled": true,
+        "hosts": 4,                    // virtual host groups
+        "checkpoint_dir": "ckpts",     // save_dir (ctor may override)
+        "checkpoint_interval": 10,     // optimizer steps between saves
+        "drain_timeout_sec": 5.0,      // writer drain before abandon
+        "load_retries": 3,             // transient-read retries
+        "escalate_after": 3,           // consecutive stalls -> terminal
+        "grow_at_checkpoint_boundary": true,
+        "loss_continuity_atol": 1e-3,  // replayed-step loss tolerance
+        "max_recoveries": 16           // give-up bound
+      }
+    }
+
+The supervisor syncs the loss to host every step (it is a resilience
+harness, not the zero-sync hot loop); production runs that want both
+wrap the supervisor's step with their own fence cadence.
+
+Stall-recovery scope: fault events are consumed BETWEEN steps, so the
+escalated-stall path recovers HOST-side stalls — a wedged input
+pipeline, a hung batch_fn, a stuck checkpoint barrier — where the loop
+regains control and sees the queued verdict. A device wedged inside a
+dispatched collective blocks `train_batch` itself; no in-process actor
+can preempt that (the watchdog's `stall_probe` tells the two apart,
+and its escalated flight dump is the hand-off to an external
+process-level supervisor that must SIGKILL and restart — which this
+supervisor then survives via `run()`'s committed-progress adoption).
+"""
+
+import copy
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import NamedTuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config)
+from deepspeed_tpu.runtime import checkpoint as ckpt_io
+from deepspeed_tpu.runtime.mesh import host_device_groups, reform_mesh
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.version import __version__
+
+__all__ = [
+    "FaultEvent", "FaultInjector", "BatchSpec", "ElasticRuntimeConfig",
+    "ElasticSupervisor", "LossContinuityError", "classify_failure",
+    "HOST_LOST", "HOST_SLOW", "STALL", "STALL_ESCALATED",
+    "CAPACITY_RETURNED",
+]
+
+# failure/event taxonomy
+HOST_LOST = "host_lost"
+HOST_SLOW = "host_slow"
+STALL = "stall"
+STALL_ESCALATED = "stall_escalated"
+CAPACITY_RETURNED = "capacity_returned"
+ENGINE_ERROR = "engine_error"
+
+
+class LossContinuityError(ElasticityError):
+    """A replayed post-resume step's loss diverged from the recorded
+    pre-failure trajectory beyond loss_continuity_atol — the restore
+    did not reproduce the checkpointed state."""
+
+
+class FaultEvent:
+    """One injected or detected fault."""
+
+    __slots__ = ("kind", "host", "info", "ts")
+
+    def __init__(self, kind, host=None, info=None):
+        self.kind = kind
+        self.host = host
+        self.info = dict(info or {})
+        self.ts = time.monotonic()
+
+    def __repr__(self):
+        return (f"FaultEvent({self.kind!r}, host={self.host!r}"
+                + (f", info={self.info}" if self.info else "") + ")")
+
+
+class FaultInjector:
+    """Chaos harness for the supervisor.
+
+    Each virtual "host" may be backed by a sentinel subprocess
+    (`spawn_host`) whose liveness stands in for the host's: SIGKILLing
+    it (`sigkill_host`) is the chaos test's host crash, and `poll()`
+    reports the death as a `host_lost` event exactly once. Faults can
+    also be injected directly (`mark_host_lost` / `mark_host_slow` /
+    `inject_stall` / `return_capacity`) for device-group-level
+    scenarios with no subprocess at all. Thread-safe: the watchdog
+    thread and the supervisor loop may both touch the queue.
+    """
+
+    _SENTINEL = "import time\nwhile True:\n    time.sleep(3600)\n"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = deque()
+        self._procs = {}        # host_id -> Popen
+        self._reported = set()  # host_ids whose death was emitted
+
+    # -- sentinel "host" subprocesses ---------------------------------
+    def spawn_host(self, host_id):
+        """Start a sentinel subprocess standing in for `host_id`. A
+        dead predecessor sentinel (the host was killed, then capacity
+        returned) is evicted so the host can be re-backed — and
+        re-killed. Respawning over a LIVE sentinel is an error.
+        Returns the new pid."""
+        with self._lock:
+            old = self._procs.get(host_id)
+            if old is not None:
+                if old.poll() is None:
+                    raise ValueError(
+                        f"host {host_id} already has a live sentinel "
+                        f"(pid {old.pid})")
+                old.wait()
+                del self._procs[host_id]
+            proc = subprocess.Popen(
+                [sys.executable, "-c", self._SENTINEL],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            self._procs[host_id] = proc
+            self._reported.discard(host_id)
+            return proc.pid
+
+    def sigkill_host(self, host_id):
+        """SIGKILL the host's sentinel — the injected crash. Detection
+        happens at the supervisor's next poll, like a real lost host."""
+        with self._lock:
+            proc = self._procs[host_id]
+        os.kill(proc.pid, signal.SIGKILL)
+
+    def host_dead(self, host_id):
+        """True once `host_id`'s sentinel has exited (e.g. the SIGKILL
+        was delivered and the kernel reaped it). False for hosts with
+        no sentinel."""
+        with self._lock:
+            proc = self._procs.get(host_id)
+        return proc is not None and proc.poll() is not None
+
+    def wait_host_dead(self, host_id, timeout=10.0):
+        """Block (up to `timeout` seconds) until the sentinel's death
+        is observable — chaos harnesses use this between the SIGKILL
+        and the poll they expect to detect it. Returns True when dead,
+        False on timeout."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            if self.host_dead(host_id):
+                return True
+            time.sleep(0.01)
+        return False
+
+    # -- direct fault injection ---------------------------------------
+    def _push(self, event):
+        with self._lock:
+            self._queue.append(event)
+
+    def mark_host_lost(self, host_id, **info):
+        """Mark a device group lost on the virtual mesh directly (no
+        subprocess involved)."""
+        self._push(FaultEvent(HOST_LOST, host=host_id, info=info))
+
+    def mark_host_slow(self, host_id, **info):
+        self._push(FaultEvent(HOST_SLOW, host=host_id, info=info))
+
+    def inject_stall(self, **info):
+        """Simulate one watchdog stall fire."""
+        self._push(FaultEvent(STALL, info=info))
+
+    def return_capacity(self, host_id, **info):
+        """The preempted capacity came back: the supervisor schedules a
+        grow at the next checkpoint boundary."""
+        self._push(FaultEvent(CAPACITY_RETURNED, host=host_id,
+                              info=info))
+
+    # -- detection ----------------------------------------------------
+    def poll(self):
+        """Drain pending events; dead sentinels become `host_lost`
+        events (reported once per death)."""
+        events = []
+        with self._lock:
+            for host_id, proc in self._procs.items():
+                if host_id in self._reported:
+                    continue
+                rc = proc.poll()
+                if rc is not None:
+                    self._reported.add(host_id)
+                    events.append(FaultEvent(
+                        HOST_LOST, host=host_id,
+                        info={"returncode": rc, "pid": proc.pid}))
+            while self._queue:
+                events.append(self._queue.popleft())
+        return events
+
+    def close(self):
+        """Terminate any sentinels still alive."""
+        with self._lock:
+            procs, self._procs = dict(self._procs), {}
+            self._reported.clear()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def classify_failure(events, consecutive_stalls=0, escalate_after=3):
+    """Map a batch of fault events (+ the running consecutive-stall
+    count) to one verdict: (kind | None, lost_hosts, returned_hosts,
+    new_consecutive_stalls).
+
+    Severity order: lost host > slow host > escalated stall >
+    transient stall. A slow host is treated as lost — on preemptible
+    capacity a straggler poisons every collective, so dropping it and
+    re-forming is the production move. Transient stalls only become a
+    verdict after `escalate_after` consecutive fires (or an explicit
+    `stall_escalated` event from the watchdog)."""
+    lost = {e.host for e in events if e.kind == HOST_LOST}
+    slow = {e.host for e in events if e.kind == HOST_SLOW}
+    returned = sorted({e.host for e in events
+                       if e.kind == CAPACITY_RETURNED})
+    stalls = sum(1 for e in events if e.kind == STALL)
+    escalated = any(e.kind == STALL_ESCALATED for e in events)
+    if lost or slow:
+        # one recovery drops BOTH: events are one-shot (the queue was
+        # drained), so a straggler reported alongside a dead host must
+        # not survive into the re-formed mesh
+        return (HOST_LOST if lost else HOST_SLOW), \
+            sorted(lost | slow), returned, 0
+    if escalated:
+        return STALL_ESCALATED, [], returned, 0
+    if stalls:
+        consecutive_stalls += stalls
+        if escalate_after and consecutive_stalls >= escalate_after:
+            return STALL_ESCALATED, [], returned, 0
+        return STALL, [], returned, consecutive_stalls
+    return None, [], returned, consecutive_stalls
+
+
+class BatchSpec(NamedTuple):
+    """Batch geometry at one world size. `total` (the elastic batch
+    size) is invariant across re-forms; rows = micro * world is the
+    global row count of one microbatch (sharded over the data axis)."""
+    world: int
+    micro: int
+    gas: int
+    total: int
+
+    @property
+    def rows(self):
+        return self.micro * self.world
+
+
+class ElasticRuntimeConfig:
+    """Validated view of the "elasticity.runtime" block."""
+
+    def __init__(self, block):
+        block = dict(block or {})
+        self.enabled = bool(block.get("enabled", False))
+        self.hosts = int(block.get("hosts", 1))
+        self.checkpoint_dir = block.get("checkpoint_dir",
+                                        "elastic_ckpts")
+        self.checkpoint_interval = int(block.get("checkpoint_interval",
+                                                 10))
+        self.drain_timeout_sec = float(block.get("drain_timeout_sec",
+                                                 5.0))
+        self.load_retries = int(block.get("load_retries", 3))
+        self.escalate_after = int(block.get("escalate_after", 3))
+        self.grow_at_checkpoint_boundary = bool(
+            block.get("grow_at_checkpoint_boundary", True))
+        self.loss_continuity_atol = float(
+            block.get("loss_continuity_atol", 1e-3))
+        self.max_recoveries = int(block.get("max_recoveries", 16))
+        if self.hosts < 1:
+            raise ElasticityConfigError(
+                f"elasticity.runtime.hosts must be >= 1, "
+                f"got {self.hosts}")
+        if self.checkpoint_interval < 1:
+            raise ElasticityConfigError(
+                "elasticity.runtime.checkpoint_interval must be >= 1, "
+                f"got {self.checkpoint_interval}")
+        if self.drain_timeout_sec <= 0:
+            raise ElasticityConfigError(
+                "elasticity.runtime.drain_timeout_sec must be > 0, "
+                f"got {self.drain_timeout_sec}")
+        if self.load_retries < 0 or self.escalate_after < 0 or \
+                self.max_recoveries < 1:
+            raise ElasticityConfigError(
+                "bad elasticity.runtime bounds: "
+                f"load_retries={self.load_retries}, "
+                f"escalate_after={self.escalate_after}, "
+                f"max_recoveries={self.max_recoveries}")
+
+
+class ElasticSupervisor:
+    """Owns a train loop end-to-end and survives host loss.
+
+    Args:
+      ds_config: full config dict; must carry an enabled "elasticity"
+        block with a "runtime" sub-block. The engine config is derived
+        from it per world size (batch triple re-derived; the
+        "elasticity" block itself is stripped — the supervisor IS the
+        elastic runtime).
+      model_factory: () -> (model, params). Called once per engine
+        build; params must init deterministically (they are replaced
+        by the checkpoint on every recovery, so determinism only
+        matters for a from-scratch start).
+      batch_fn: (global_step, BatchSpec) -> stacked [gas, rows, ...]
+        batch pytree. MUST be a pure function of its arguments: replay
+        determinism (and the chaos test's bit-identical contract)
+        depends on it.
+      save_dir: checkpoint directory (defaults to the config's
+        checkpoint_dir).
+      devices: device list to supervise (defaults to jax.devices()).
+      injector: a FaultInjector (a fresh one is built if omitted).
+    """
+
+    def __init__(self, ds_config, model_factory, batch_fn,
+                 save_dir=None, devices=None, injector=None):
+        self.ds_config = copy.deepcopy(ds_config)
+        el = self.ds_config.get("elasticity") or {}
+        if not el.get("enabled", False):
+            raise ElasticityConfigError(
+                "ElasticSupervisor requires an enabled 'elasticity' "
+                "config block")
+        self.rt = ElasticRuntimeConfig(el.get("runtime"))
+        if not self.rt.enabled:
+            raise ElasticityConfigError(
+                "ElasticSupervisor requires elasticity.runtime.enabled")
+        mesh_block = dict(self.ds_config.get("mesh") or {})
+        for axis in ("pipe", "model"):
+            if int(mesh_block.get(axis, 1)) != 1:
+                raise ElasticityConfigError(
+                    "ElasticSupervisor re-forms pure data-parallel "
+                    f"meshes; mesh.{axis}={mesh_block[axis]} is not "
+                    "supported — run model/pipe-parallel jobs under "
+                    "plain deepspeed_tpu.initialize()")
+        self.model_factory = model_factory
+        self.batch_fn = batch_fn
+        self.injector = injector if injector is not None \
+            else FaultInjector()
+        self.save_dir = save_dir or self.rt.checkpoint_dir
+        all_devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self._groups = host_device_groups(all_devices, self.rt.hosts)
+        self._alive = set(range(self.rt.hosts))
+        self._stall_queue = deque()   # fed by watchdog threads
+        self._consecutive_stalls = 0
+        self._returned_pending = set()
+        self._carried_abandoned = []  # writers surviving a rebuild
+        self._pending_grow = False
+        self.engine = None
+        self.devices = []
+        self.batch_spec = None
+        self.zero_plan = None
+        self.events = []              # recovery / scale_up records
+        self.loss_history = {}        # step -> loss (pre-overwrite
+        self._replay_until = 0        # steps < this are replays
+        self.recoveries = 0
+        self._step = 0
+
+    # ------------------------------------------------------------------
+    # elastic config math
+    # ------------------------------------------------------------------
+    def _valid_worlds(self):
+        _, valid = compute_elastic_config(self.ds_config, __version__)
+        return valid
+
+    def _select_world(self, n_devices):
+        """Largest compatible device count <= the survivor count."""
+        valid = [g for g in self._valid_worlds() if g <= n_devices]
+        if not valid:
+            raise ElasticityIncompatibleWorldSize(
+                f"no compatible device count <= {n_devices} survivors "
+                f"(valid: {self._valid_worlds()}); cannot re-form")
+        return max(valid)
+
+    def _plan(self, world):
+        total, _, micro = compute_elastic_config(
+            self.ds_config, __version__, world_size=world)
+        gas = total // (micro * world)
+        return BatchSpec(world=world, micro=micro, gas=gas, total=total)
+
+    def _surviving_devices(self):
+        return [d for h in sorted(self._alive) for d in self._groups[h]]
+
+    # ------------------------------------------------------------------
+    # engine lifecycle
+    # ------------------------------------------------------------------
+    def _engine_config(self, spec):
+        cfg = copy.deepcopy(self.ds_config)
+        cfg.pop("elasticity", None)   # the supervisor IS the runtime
+        cfg.pop("mesh", None)         # mesh is built explicitly
+        cfg["train_batch_size"] = spec.total
+        cfg["train_micro_batch_size_per_gpu"] = spec.micro
+        cfg["gradient_accumulation_steps"] = spec.gas
+        return cfg
+
+    def _build_engine(self, devices):
+        import deepspeed_tpu
+        world = self._select_world(len(devices))
+        devices = list(devices)[:world]
+        spec = self._plan(world)
+        mesh = reform_mesh(devices)
+        model, params = self.model_factory()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config=self._engine_config(spec), mesh=mesh)
+        wd = engine.monitor.watchdog
+        if wd is not None:
+            # the supervisor consumes the watchdog's diagnostics: each
+            # fire is a transient-stall vote, the escalation a
+            # terminal verdict
+            wd.on_stall = self._on_stall
+            wd.on_escalate = self._on_escalate
+            if self.rt.escalate_after and not wd.escalate_after:
+                wd.escalate_after = self.rt.escalate_after
+        # abandoned writers from the torn-down predecessor may still
+        # own `<tag>.tmp` staging dirs; the successor must keep
+        # refusing those tags or a replayed boundary save could write
+        # into a dir the stale thread is mid-write in
+        if self._carried_abandoned:
+            engine._abandoned_ckpt_writers = [
+                w for w in self._carried_abandoned if w.pending()]
+            self._carried_abandoned = []
+        self.engine = engine
+        self.devices = devices
+        self.batch_spec = spec
+        # the re-planned ZeRO partition for THIS world size (pure
+        # metadata math over abstract shapes, with the ENGINE's actual
+        # byte settings; rides the recovery event so a post-mortem can
+        # see per-device bytes before/after the re-form)
+        try:
+            shapes = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(np.shape(l), l.dtype),
+                engine.state.params)
+            self.zero_plan = engine.zero_policy.memory_plan(
+                shapes,
+                compute_bytes=np.dtype(engine.compute_dtype).itemsize,
+                sr_mode=engine.bf16_sr_mode, gas=engine._jit_gas())
+        except Exception:
+            self.zero_plan = None
+        return engine
+
+    def _teardown_engine(self, drain=True):
+        """Drop the current engine: drain (or, on timeout, abandon)
+        its checkpoint writers and stop its monitor threads. Device
+        buffers free once the reference dies. Abandoned writers with
+        jobs still alive are carried over to the successor engine's
+        same-tag guard."""
+        engine, self.engine = self.engine, None
+        if engine is None:
+            return
+        try:
+            engine.shutdown(
+                wait_for_checkpoint=drain,
+                checkpoint_timeout=self.rt.drain_timeout_sec)
+        except Exception as e:
+            logger.warning(f"engine teardown raised: {e}")
+        finally:
+            self._carried_abandoned = [
+                w for w in getattr(engine, "_abandoned_ckpt_writers",
+                                   [])
+                if w.pending()]
+
+    # ------------------------------------------------------------------
+    # checkpointing
+    # ------------------------------------------------------------------
+    def _checkpoint(self):
+        tag = f"global_step{self._step}"
+        try:
+            self.engine.save_checkpoint(self.save_dir, tag=tag)
+        except Exception as e:
+            # a failed save must not kill the run — the next boundary
+            # retries with a fresh tag; recovery uses the last
+            # COMMITTED one either way
+            logger.warning(f"checkpoint save '{tag}' failed: {e}")
+
+    def _load_latest(self):
+        """Newest committed tag -> engine (resharded restore under the
+        CURRENT mesh). Returns the restored global step, or None when
+        no committed checkpoint exists."""
+        tag = ckpt_io.read_latest_tag(self.save_dir,
+                                      retries=self.rt.load_retries)
+        if tag is None:
+            return None, None
+        self.engine.load_checkpoint(self.save_dir, tag=tag,
+                                    retries=self.rt.load_retries)
+        return tag, int(self.engine.global_steps)
+
+    # ------------------------------------------------------------------
+    # recovery + scale-up
+    # ------------------------------------------------------------------
+    def _emit_event(self, event):
+        self.events.append(event)
+        eng = self.engine
+        if eng is not None and eng.monitor.enabled:
+            try:
+                # monitor.event already records into the flight ring;
+                # recoveries additionally pin the sticky last_recovery
+                # context (scale_ups must not overwrite the forensic
+                # record of the last failure)
+                eng.monitor.event(event["kind"],
+                                  **{k: v for k, v in event.items()
+                                     if k != "kind"})
+                if eng.monitor.flight is not None and \
+                        event["kind"] == "recovery":
+                    eng.monitor.flight.set_context(
+                        last_recovery=dict(event))
+            except Exception:
+                pass
+
+    def _recover(self, cause, lost_hosts=(), error=None):
+        detect_t = time.monotonic()
+        self.recoveries += 1
+        if self.recoveries > self.rt.max_recoveries:
+            raise ElasticityError(
+                f"giving up after {self.recoveries - 1} recoveries "
+                f"(elasticity.runtime.max_recoveries="
+                f"{self.rt.max_recoveries}); last cause: {cause}")
+        for h in lost_hosts:
+            self._alive.discard(h)
+        if not self._alive:
+            raise ElasticityError(
+                f"every host is lost (cause: {cause}); nothing to "
+                "re-form onto")
+        old_world = self.batch_spec.world if self.batch_spec else None
+        old_step = self._step
+        logger.warning(
+            f"RECOVERY ({cause}): lost hosts {sorted(lost_hosts)}; "
+            f"re-forming on hosts {sorted(self._alive)}"
+            + (f"; error: {error!r}" if error is not None else ""))
+        # 1. drain/abandon writers + stop monitor threads
+        self._teardown_engine(drain=True)
+        # 2..5. re-form mesh, re-plan ZeRO, rebuild engine
+        self._build_engine(self._surviving_devices())
+        # 6. resharded restore from the newest committed checkpoint
+        tag, restored = self._load_latest()
+        if tag is None:
+            logger.warning(
+                "recovery found no committed checkpoint; restarting "
+                "from scratch (step 0)")
+            self._step = 0
+        else:
+            self._step = restored
+        # steps in [self._step, old_step) are replays: their losses
+        # must reproduce the recorded trajectory (continuity assert)
+        self._replay_until = max(self._replay_until, old_step)
+        self._consecutive_stalls = 0
+        event = {
+            "kind": "recovery",
+            "cause": cause,
+            "lost_hosts": sorted(lost_hosts),
+            "world_before": old_world,
+            "world_after": self.batch_spec.world,
+            "micro_batch": self.batch_spec.micro,
+            "gradient_accumulation_steps": self.batch_spec.gas,
+            "resumed_from_tag": tag,
+            "resumed_step": self._step,
+            "replayed_steps": max(0, old_step - self._step),
+            "detect_to_resume_sec": round(
+                time.monotonic() - detect_t, 3),
+            "zero_plan_bytes": {k: int(v) for k, v in
+                                (self.zero_plan or {}).items()},
+        }
+        if error is not None:
+            event["error"] = repr(error)
+        self._emit_event(event)
+        return event
+
+    def _grow(self):
+        """Scale back up to the returned capacity — only ever called
+        right after a checkpoint boundary, so no unsaved work is at
+        stake. The full-world rebuild reloads the just-committed
+        checkpoint under the larger mesh. A grow is VOLUNTARY: if the
+        boundary save did not commit (failed save, wedged writer),
+        the grow is deferred to the next boundary instead of
+        reloading an older tag and discarding work."""
+        t0 = time.monotonic()
+        grown = self._surviving_devices()
+        if self.engine is not None and len(grown) <= len(self.devices):
+            self._pending_grow = False
+            return None
+        old_world = self.batch_spec.world if self.batch_spec else None
+        old_step = self._step
+        # bounded wait for the boundary save to commit (an unbounded
+        # wait on a wedged writer would hang the supervisor — the
+        # exact failure mode this module exists to survive)
+        try:
+            self.engine.wait_for_checkpoint(
+                timeout=self.rt.drain_timeout_sec)
+        except (ckpt_io.CheckpointWaitTimeout, RuntimeError) as e:
+            logger.warning(f"grow: boundary save did not drain ({e})")
+        committed = ckpt_io.read_latest_tag(
+            self.save_dir, retries=self.rt.load_retries)
+        if committed != f"global_step{self._step}":
+            # the boundary save never committed: growing now would
+            # reload an OLDER tag and voluntarily discard work — defer
+            # to the next boundary (keep _pending_grow armed)
+            logger.warning(
+                f"grow deferred: latest committed tag is {committed!r}, "
+                f"expected 'global_step{self._step}'; retrying at the "
+                "next checkpoint boundary")
+            return None
+        self._teardown_engine(drain=True)
+        self._build_engine(grown)
+        tag, restored = self._load_latest()
+        self._step = restored if tag is not None else 0
+        self._replay_until = max(self._replay_until, old_step)
+        self._pending_grow = False
+        event = {
+            "kind": "scale_up",
+            "world_before": old_world,
+            "world_after": self.batch_spec.world,
+            "micro_batch": self.batch_spec.micro,
+            "gradient_accumulation_steps": self.batch_spec.gas,
+            "resumed_from_tag": tag,
+            "resumed_step": self._step,
+            "rebuild_sec": round(time.monotonic() - t0, 3),
+            "zero_plan_bytes": {k: int(v) for k, v in
+                                (self.zero_plan or {}).items()},
+        }
+        self._emit_event(event)
+        return event
+
+    # ------------------------------------------------------------------
+    # loss continuity
+    # ------------------------------------------------------------------
+    def _note_loss(self, step, loss):
+        if not np.isfinite(loss):
+            raise LossContinuityError(
+                f"non-finite loss {loss} at step {step}")
+        prev = self.loss_history.get(step)
+        if prev is not None and step < self._replay_until:
+            if abs(prev - loss) > self.rt.loss_continuity_atol:
+                raise LossContinuityError(
+                    f"replayed step {step} loss {loss!r} diverged from "
+                    f"the pre-failure trajectory {prev!r} by "
+                    f"{abs(prev - loss):.3e} > loss_continuity_atol="
+                    f"{self.rt.loss_continuity_atol} — the restore did "
+                    "not reproduce the checkpointed state")
+        self.loss_history[step] = loss
+
+    # ------------------------------------------------------------------
+    # the loop
+    # ------------------------------------------------------------------
+    def run(self, num_steps):
+        """Train to `num_steps` optimizer steps, surviving faults.
+        Returns the run report (see `report()`)."""
+        if self.engine is None:
+            self._build_engine(self._surviving_devices())
+            # adopt prior progress if this save_dir already has
+            # committed checkpoints (a supervisor restart is itself a
+            # recovery)
+            tag, restored = self._load_latest()
+            if tag is not None:
+                self._step = restored
+        while self._step < num_steps:
+            verdict, hosts = self._poll_faults()
+            if verdict in (HOST_LOST, HOST_SLOW, STALL_ESCALATED):
+                self._recover(cause=verdict, lost_hosts=hosts)
+                self._apply_returns()   # a return reported alongside
+                continue                # the loss rejoins AFTER it
+            self._apply_returns()
+            # a transient (non-escalated) stall: keep stepping — the
+            # vote count persists until a CLEAN poll (consecutive
+            # fires without clean evidence in between escalate, even
+            # when slow steps keep completing)
+            try:
+                batch = self.batch_fn(self._step, self.batch_spec)
+                loss = float(jax.device_get(
+                    self.engine.train_batch(batch=batch)))
+            except LossContinuityError:
+                raise
+            except Exception as e:
+                # input-pipeline failures recover exactly like engine
+                # failures — batch_fn is part of the supervised loop
+                self._recover(cause=ENGINE_ERROR, error=e)
+                self._apply_returns()
+                continue
+            self._note_loss(self._step, loss)
+            self._step += 1
+            if verdict is None:
+                self._consecutive_stalls = 0
+            if self._step % self.rt.checkpoint_interval == 0:
+                self._checkpoint()
+                if self._pending_grow and \
+                        self.rt.grow_at_checkpoint_boundary:
+                    self._grow()
+        try:
+            # bounded: a wedged final writer must not hang the return,
+            # and a FAILED background save must not raise after every
+            # step succeeded (mid-run _checkpoint swallows the same)
+            self.engine.wait_for_checkpoint(
+                timeout=self.rt.drain_timeout_sec)
+        except ckpt_io.CheckpointWaitTimeout as e:
+            logger.warning(f"final checkpoint drain timed out: {e}")
+        except RuntimeError as e:
+            logger.warning(
+                f"final checkpoint drain: background save failed: {e}")
+        return self.report()
+
+    # ------------------------------------------------------------------
+    def report(self):
+        return {
+            "steps": self._step,
+            "world_size": self.batch_spec.world
+            if self.batch_spec else None,
+            "micro_batch": self.batch_spec.micro
+            if self.batch_spec else None,
+            "gradient_accumulation_steps": self.batch_spec.gas
+            if self.batch_spec else None,
+            "device_ids": [int(d.id) for d in self.devices],
+            "alive_hosts": sorted(self._alive),
+            "recoveries": [dict(e) for e in self.events
+                           if e["kind"] == "recovery"],
+            "scale_ups": [dict(e) for e in self.events
+                          if e["kind"] == "scale_up"],
+            "losses": dict(self.loss_history),
+        }
+
+    def close(self):
+        self._teardown_engine(drain=True)
+        self.injector.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    # fault intake
+    # ------------------------------------------------------------------
+    def _on_stall(self, diag):
+        self._stall_queue.append(FaultEvent(STALL, info=diag))
+
+    def _on_escalate(self, diag):
+        self._stall_queue.append(FaultEvent(STALL_ESCALATED, info=diag))
+
+    def _poll_faults(self):
+        events = list(self.injector.poll())
+        while self._stall_queue:
+            events.append(self._stall_queue.popleft())
+        verdict, hosts, returned, self._consecutive_stalls = \
+            classify_failure(events, self._consecutive_stalls,
+                             self.rt.escalate_after)
+        # stash capacity returns; they apply AFTER any recovery in the
+        # same batch (a host reported lost AND returned in one poll
+        # must first be dropped, then rejoin — not be silently eaten)
+        self._returned_pending.update(returned)
+        return verdict, hosts
+
+    def _apply_returns(self):
+        for h in sorted(self._returned_pending):
+            if h not in self._alive:
+                self._alive.add(h)
+                self._pending_grow = True
+                logger.info(f"capacity returned: host {h}; grow "
+                            "scheduled for the next checkpoint boundary")
+        self._returned_pending.clear()

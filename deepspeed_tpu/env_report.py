@@ -169,6 +169,16 @@ def feature_report():
             "BERT stacks + sequential pipe chains)"))
     except Exception as e:
         rows.append(("ZeRO-3 overlap", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.elasticity.runtime import \
+            ElasticSupervisor  # noqa: F401
+        rows.append((
+            "elastic runtime",
+            f"{SUCCESS} fault-injecting supervisor: mesh re-form + "
+            "ZeRO re-plan + resharded resume (elasticity.runtime; "
+            "docs/elasticity.md)"))
+    except Exception as e:
+        rows.append(("elastic runtime", f"{FAIL} {e}"))
 
     print("-" * 64)
     print("runtime feature report")

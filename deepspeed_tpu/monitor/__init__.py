@@ -161,6 +161,7 @@ class Monitor:
             self.watchdog = StallWatchdog(
                 config.stall_timeout_sec,
                 probe=config.stall_probe,
+                escalate_after=config.stall_escalate_after,
                 emit=self._emit_kind)
 
     def _flight_step(self):
@@ -530,12 +531,15 @@ class Monitor:
                 "ckpt_writer", f"commit {fields.get('tag', '')}",
                 time.perf_counter() - wall, wall, cat=CAT_SUBSYSTEM,
                 args={"tag": fields.get("tag")})
-        if kind == "stall":
+        if kind in ("stall", "stall_escalated"):
             # the forensic moment: freeze the evidence while the run is
-            # still (maybe) wedged — flight dump + trace export
+            # still (maybe) wedged — flight dump + trace export. An
+            # escalation is terminal for the episode: its dump carries
+            # the consecutive-fire diagnostic a recovery post-mortem
+            # starts from.
             if self.flight is not None:
                 try:
-                    self.flight.dump("stall", extra=fields)
+                    self.flight.dump(kind, extra=fields)
                 except Exception:
                     pass
             self._export_trace_safe()

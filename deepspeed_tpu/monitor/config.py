@@ -22,6 +22,10 @@ stall_timeout_sec: fire the stall watchdog when no sync fence advances
   for this long (0 = watchdog off).
 stall_probe: on a stall, also time an `effects_barrier` on a
   sacrificial thread to tell a wedged device from a stalled host.
+stall_escalate_after: consecutive watchdog fires (one per further
+  stall_timeout_sec of silence) before ONE terminal `stall_escalated`
+  event is emitted — flight dump + sink event — and the episode goes
+  quiet (0 = off; the elastic supervisor consumes the verdict).
 all_ranks: emit events from every process (default: rank 0 only, with
   a per-rank filename suffix when enabled).
 peak_flops_override: MFU denominator in FLOP/s per chip (0 = auto:
@@ -94,6 +98,13 @@ class DeepSpeedMonitorConfig:
                 f"got {self.stall_timeout_sec}")
         self.stall_probe = bool(get_scalar_param(
             block, C.MONITOR_STALL_PROBE, C.MONITOR_STALL_PROBE_DEFAULT))
+        self.stall_escalate_after = int(get_scalar_param(
+            block, C.MONITOR_STALL_ESCALATE_AFTER,
+            C.MONITOR_STALL_ESCALATE_AFTER_DEFAULT))
+        if self.stall_escalate_after < 0:
+            raise MonitorConfigError(
+                "monitor.stall_escalate_after must be >= 0 (0 = off), "
+                f"got {self.stall_escalate_after}")
         self.all_ranks = bool(get_scalar_param(
             block, C.MONITOR_ALL_RANKS, C.MONITOR_ALL_RANKS_DEFAULT))
         self.peak_flops_override = float(get_scalar_param(

@@ -18,6 +18,17 @@ stall is host-side; if it never returns the device itself is wedged;
 the probe thread is sacrificial so a hung barrier can't wedge the
 watchdog too). The watchdog re-arms after each fire, so a run that
 stalls, recovers, and stalls again reports both episodes.
+
+Escalation (`escalate_after=N`): a stall that persists keeps firing —
+one `on_stall` per further `timeout_sec` of silence — with a
+consecutive-fire counter; at the Nth consecutive fire a terminal
+`stall_escalated` event is emitted EXACTLY ONCE per episode (sink
+event + `on_escalate(diag)` callback; the monitor also dumps the
+flight recorder on it), after which the episode goes quiet until a
+fence re-arms it. A supervisor (elasticity/runtime.py) uses the
+escalated verdict to give up waiting and execute recovery instead.
+With escalate_after=0 (the default) behavior is unchanged: one fire
+per episode, no terminal event.
 """
 
 import threading
@@ -28,11 +39,14 @@ from deepspeed_tpu.utils.logging import logger
 
 class StallWatchdog:
     def __init__(self, timeout_sec, on_stall=None, probe=False,
-                 emit=None, poll_interval=None):
+                 emit=None, poll_interval=None, escalate_after=0,
+                 on_escalate=None):
         assert timeout_sec > 0, timeout_sec
         self.timeout_sec = float(timeout_sec)
         self.on_stall = on_stall
         self.probe = probe
+        self.escalate_after = int(escalate_after or 0)
+        self.on_escalate = on_escalate
         self._emit = emit            # monitor event hook (thread-safe)
         self._poll = poll_interval or min(self.timeout_sec / 4.0, 5.0)
         self._lock = threading.Lock()
@@ -40,7 +54,11 @@ class StallWatchdog:
         self._heartbeats = {}
         self._terminal = set()       # finished subsystems (not stalled)
         self._fired_for = None       # fence timestamp already reported
+        self._last_fire_t = None     # wall time of the episode's last fire
+        self._consecutive = 0        # fires since the last fence
+        self._escalated = False      # terminal event sent for this episode
         self.stall_count = 0
+        self.escalation_count = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name="ds-tpu-watchdog", daemon=True)
@@ -56,6 +74,9 @@ class StallWatchdog:
         with self._lock:
             self._last_fence = time.monotonic()
             self._fired_for = None
+            self._last_fire_t = None
+            self._consecutive = 0
+            self._escalated = False
 
     def arm(self):
         """Start the stall clock without counting progress (called at
@@ -119,16 +140,37 @@ class StallWatchdog:
             with self._lock:
                 last = self._last_fence
                 fired = self._fired_for
-            if last is None or fired == last:
+                last_fire = self._last_fire_t
+                escalated = self._escalated
+            if last is None:
                 continue
+            if fired == last:
+                # already reported this episode: with escalation on,
+                # keep re-firing every further timeout_sec of silence
+                # (counting consecutive fires) until the terminal
+                # verdict; the default keeps one fire per episode
+                if self.escalate_after <= 0 or escalated or \
+                        last_fire is None or \
+                        time.monotonic() - last_fire < self.timeout_sec:
+                    continue
             now = time.monotonic()
             age = now - last
             if age < self.timeout_sec:
                 continue
             with self._lock:
                 self._fired_for = last
+                self._last_fire_t = now
                 self.stall_count += 1
+                self._consecutive += 1
+                consecutive = self._consecutive
+                escalate = (self.escalate_after > 0 and
+                            consecutive >= self.escalate_after and
+                            not self._escalated)
+                if escalate:
+                    self._escalated = True
+                    self.escalation_count += 1
             diag = self._diagnose(now, age)
+            diag["consecutive_fires"] = consecutive
             term = diag.get("terminal_subsystems") or []
             logger.warning(
                 f"STALL: no sync fence for {age:.1f}s "
@@ -147,6 +189,24 @@ class StallWatchdog:
                     self.on_stall(diag)
                 except Exception as e:
                     logger.warning(f"stall callback raised: {e}")
+            if escalate:
+                ediag = dict(diag, escalate_after=self.escalate_after)
+                logger.error(
+                    f"STALL ESCALATED: {consecutive} consecutive "
+                    f"watchdog fires with no progress (escalate_after="
+                    f"{self.escalate_after}); this episode is terminal "
+                    "— a supervisor should recover, not keep waiting")
+                if self._emit is not None:
+                    try:
+                        self._emit("stall_escalated", ediag)
+                    except Exception:
+                        pass
+                if self.on_escalate is not None:
+                    try:
+                        self.on_escalate(ediag)
+                    except Exception as e:
+                        logger.warning(
+                            f"escalation callback raised: {e}")
 
     def stop(self):
         self._stop.set()

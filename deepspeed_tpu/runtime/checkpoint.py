@@ -35,6 +35,8 @@ import pickle
 import re
 import shutil
 import threading
+import time
+import zipfile
 
 import jax
 import numpy as np
@@ -53,6 +55,71 @@ STAGING_SUFFIX = ".tmp"
 
 _SHARD_RE = re.compile(
     r"zero_pp_rank_(\d+)_mp_rank_(\d+)(optim|model)_states\.npz$")
+
+
+# ----------------------------------------------------------------------
+# error taxonomy (the elastic supervisor acts on the distinction)
+# ----------------------------------------------------------------------
+class CheckpointNotFoundError(FileNotFoundError):
+    """No checkpoint exists under the requested tag at all — nothing
+    was ever saved (or rotation removed it). Recovery action: start
+    fresh, or pick a different tag."""
+
+
+class CheckpointStagingOnlyError(FileNotFoundError):
+    """The tag exists ONLY as a `<tag>.tmp` staging dir: a save was
+    killed before its atomic commit. The staging dir must never be
+    loaded. Recovery action: load an earlier committed tag (the
+    `latest` pointer only ever names committed saves)."""
+
+
+class CheckpointWaitTimeout(TimeoutError):
+    """wait_for_checkpoint(timeout=...) expired with a writer still in
+    flight. Carries the writer's last heartbeat age so the caller can
+    tell a slow-but-alive writer from a wedged one before abandoning
+    it (engine.abandon_checkpoint_writers). Note: abandonment unblocks
+    in-process teardown/rebuild; writer threads stay non-daemon by
+    design (the interpreter will not EXIT mid-write), so a truly
+    wedged writer still blocks final process exit."""
+
+    def __init__(self, msg, pending=0, heartbeat_age_sec=None):
+        super().__init__(msg)
+        self.pending = pending
+        self.heartbeat_age_sec = heartbeat_age_sec
+
+
+# Transient read failures worth retrying: a checkpoint dir mid-commit
+# (two-rename window of commit_staging_dir), NFS attribute-cache
+# flutter, or a reader racing rotation. Structural corruption
+# (coverage mismatch, future format) is NOT retried.
+_TRANSIENT_READ_ERRORS = (OSError, zipfile.BadZipFile)
+
+
+def _retry_read(fn, retries, backoff_sec, describe):
+    """Run fn() with bounded retries on transient read errors.
+    CheckpointNotFoundError passes straight through — retrying cannot
+    create a checkpoint that was never saved. CheckpointStagingOnlyError
+    IS retried: a reader racing a same-tag RESAVE's two-rename commit
+    window (old `<tag>` moved aside, new `<tag>.tmp` not yet renamed)
+    sees exactly the staging-only signature for a few milliseconds;
+    only after the retries exhaust is it the terminal interrupted-save
+    verdict."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except CheckpointNotFoundError:
+            raise
+        except _TRANSIENT_READ_ERRORS as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            from deepspeed_tpu.utils.logging import logger
+            logger.warning(
+                f"transient checkpoint read error ({describe}, attempt "
+                f"{attempt}/{retries}): {e}; retrying in "
+                f"{backoff_sec * attempt:.2f}s")
+            time.sleep(backoff_sec * attempt)
 
 
 # ----------------------------------------------------------------------
@@ -324,20 +391,57 @@ def _load_legacy_pickle(load_dir, tag, mp_rank, dp_rank):
     return model_sd, optim_sd, True
 
 
-def load_checkpoint_flat(load_dir, tag, mp_rank=0):
+def load_checkpoint_flat(load_dir, tag, mp_rank=0, retries=0,
+                         backoff_sec=0.05):
     """Read a sharded checkpoint into ({path: np.array}, meta,
-    optim_meta, has_optim).  Paths are prefixed "module"/"optim"/"aux"."""
+    optim_meta, has_optim).  Paths are prefixed "module"/"optim"/"aux".
+
+    `retries` bounds retry-with-backoff on TRANSIENT read errors
+    (OSError/BadZipFile — a reader racing a commit's rename window or
+    rotation). Missing checkpoints fail immediately with a distinct,
+    actionable error: `CheckpointStagingOnlyError` when only the
+    `<tag>.tmp` staging dir of an interrupted save exists,
+    `CheckpointNotFoundError` when there is nothing at all."""
+    return _retry_read(
+        lambda: _load_checkpoint_flat_once(load_dir, tag, mp_rank),
+        retries, backoff_sec, f"tag '{tag}' in {load_dir}")
+
+
+def _load_checkpoint_flat_once(load_dir, tag, mp_rank=0):
     ckpt_dir = _ckpt_dir(load_dir, tag)
     base = os.path.join(ckpt_dir, MODEL_STATES_FMT.format(mp_rank))
-    if not os.path.exists(base + ".json") and \
-            os.path.isdir(staging_dir(load_dir, tag)):
-        # `<tag>.tmp` without `<tag>`: a save was killed before its
-        # atomic commit — the staging dir must never be loaded
-        raise FileNotFoundError(
-            f"checkpoint tag '{tag}' in {load_dir} only exists as an "
-            f"incomplete staging dir ('{tag}{STAGING_SUFFIX}') left by "
-            "an interrupted save; load an earlier tag (see the "
-            "'latest' pointer)")
+    if not os.path.exists(base + ".json"):
+        legacy = os.path.join(ckpt_dir,
+                              f"mp_rank_{mp_rank:02d}_model_states.pt")
+        if os.path.isdir(staging_dir(load_dir, tag)):
+            # `<tag>.tmp` without the manifest: an interrupted save —
+            # or, transiently, a same-tag resave mid-commit (the
+            # two-rename window); _retry_read retries this verdict
+            # before it becomes terminal
+            raise CheckpointStagingOnlyError(
+                f"checkpoint tag '{tag}' in {load_dir} only exists as "
+                f"an incomplete staging dir ('{tag}{STAGING_SUFFIX}') "
+                "left by an interrupted save; load an earlier tag (see "
+                "the 'latest' pointer)")
+        if not os.path.isdir(ckpt_dir):
+            raise CheckpointNotFoundError(
+                f"no checkpoint tag '{tag}' under {load_dir}: the tag "
+                "directory does not exist (never saved, or removed by "
+                "keep_last rotation)")
+        # dir present, manifest absent: terminal, not a transient to
+        # burn retries on. A legacy pickle dir gets an actionable
+        # message (this flat loader never read the .pt format — the
+        # pickle path lives in load_checkpoint_files).
+        if os.path.exists(legacy):
+            raise CheckpointNotFoundError(
+                f"checkpoint dir {ckpt_dir} holds a legacy pickle "
+                "checkpoint (mp_rank_*.pt) with no npz manifest; load "
+                "it through load_checkpoint_files / "
+                "engine.load_checkpoint")
+        raise CheckpointNotFoundError(
+            f"checkpoint dir {ckpt_dir} exists but has no manifest "
+            f"{os.path.basename(base)}.json (mp_rank mismatch, or a "
+            "corrupted/partially deleted checkpoint)")
     with open(base + ".json") as f:
         manifest = json.load(f)
     version = manifest.get("format_version", 1)
@@ -377,12 +481,14 @@ def load_checkpoint_flat(load_dir, tag, mp_rank=0):
 
 def load_checkpoint_files(load_dir, tag, zero_enabled=True, mp_rank=0,
                           dp_rank=0, module_template=None,
-                          opt_state_template=None, aux_templates=None):
+                          opt_state_template=None, aux_templates=None,
+                          retries=0):
     """Engine-facing loader.  Returns (model_sd, optim_sd) shaped like
     the save-side inputs: model_sd["module"] is a pytree when
     `module_template` is given (otherwise the flat {path: array} map
     under model_sd["module_flat"]); likewise optim_sd["opt_state"].
-    `zero_enabled` gates whether optimizer state is assembled at all."""
+    `zero_enabled` gates whether optimizer state is assembled at all.
+    `retries` bounds transient-read retries (see load_checkpoint_flat)."""
     legacy_marker = os.path.join(
         _ckpt_dir(load_dir, tag), f"mp_rank_{mp_rank:02d}_model_states.pt")
     npz_marker = model_states_path(load_dir, tag, mp_rank)
@@ -392,7 +498,7 @@ def load_checkpoint_files(load_dir, tag, zero_enabled=True, mp_rank=0,
         return model_sd, optim_sd
 
     flat, meta, opt_meta, has_optim = load_checkpoint_flat(
-        load_dir, tag, mp_rank)
+        load_dir, tag, mp_rank, retries=retries)
 
     model_sd = dict(meta)
     if module_template is not None:
@@ -574,6 +680,12 @@ class AsyncCheckpointWriter:
         assert queue_policy in ("block", "drop"), queue_policy
         self._depth = queue_depth
         self._policy = queue_policy
+        # set when the engine detaches this writer (wedged-writer
+        # recovery): jobs still commit their tag dirs atomically, but
+        # must no longer move `latest` or rotate — a stale writer
+        # unwedging AFTER a successor engine committed newer tags
+        # would otherwise regress the pointer to an older save
+        self.abandoned = threading.Event()
         self._jobs = []          # [(thread, tag)]
         self._lock = threading.Lock()
         self._error = None
@@ -592,6 +704,15 @@ class AsyncCheckpointWriter:
         """Saves currently in flight (the monitor's checkpoint
         queue-depth gauge)."""
         return len(self._reap())
+
+    def tag_in_flight(self, tag):
+        """True while a live job of THIS writer holds `tag` (and so
+        owns its `<tag>.tmp` staging dir). Successor writers consult
+        this on abandoned predecessors before touching the same tag —
+        two writers sharing one staging dir would corrupt the
+        commit."""
+        tag = str(tag)
+        return any(jt == tag for _, jt in self._reap())
 
     def _raise_pending(self):
         with self._lock:
@@ -715,18 +836,31 @@ class AsyncCheckpointWriter:
         t.start()
         return True
 
-    def wait(self):
+    def wait(self, timeout=None):
         """Barrier: block until every in-flight save has committed;
-        re-raise the first writer error, if any."""
+        re-raise the first writer error, if any.  With a `timeout`
+        (seconds, across ALL in-flight jobs) returns True when drained
+        and False when the deadline expired with a writer still alive
+        — pending errors are re-raised either way, so a wedged writer
+        cannot mask an earlier failed one."""
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
         while True:
             with self._lock:
                 jobs = list(self._jobs)
             if not jobs:
                 break
             for t, _ in jobs:
-                t.join()
+                if deadline is None:
+                    t.join()
+                else:
+                    t.join(max(0.0, deadline - time.monotonic()))
+                    if t.is_alive():
+                        self._raise_pending()
+                        return False
             self._reap()
         self._raise_pending()
+        return True
 
     def pending(self):
         return len(self._reap())
@@ -753,18 +887,28 @@ def write_latest_tag(save_dir, tag):
     _fsync_path(save_dir)
 
 
-def read_latest_tag(load_dir):
-    path = os.path.join(load_dir, LATEST_FILE)
-    if not os.path.exists(path):
+def read_latest_tag(load_dir, retries=0, backoff_sec=0.05):
+    """Read the `latest` pointer (None when absent). `retries` bounds
+    retry-with-backoff on transient OSErrors (a reader racing the
+    pointer's atomic replace on a laggy network filesystem)."""
+    def once():
+        path = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, "r") as f:
+            return f.read().strip()
+
+    tag = _retry_read(once, retries, backoff_sec,
+                      f"latest pointer in {load_dir}")
+    if tag is None:
         return None
-    with open(path, "r") as f:
-        tag = f.read().strip()
     if not tag or is_staging_name(tag):
         # a staging name can only reach `latest` by hand-editing; treat
         # it as absent rather than load a possibly half-written dir
         from deepspeed_tpu.utils.logging import logger
         logger.warning(
-            f"{path} points at staging entry {tag!r}; ignoring it")
+            f"{os.path.join(load_dir, LATEST_FILE)} points at staging "
+            f"entry {tag!r}; ignoring it")
         return None
     return tag
 

@@ -165,6 +165,14 @@ MONITOR_STALL_TIMEOUT_SEC = "stall_timeout_sec"
 MONITOR_STALL_TIMEOUT_SEC_DEFAULT = 0
 MONITOR_STALL_PROBE = "stall_probe"
 MONITOR_STALL_PROBE_DEFAULT = False
+# Terminal stall verdict: after this many CONSECUTIVE watchdog fires
+# with no intervening fence, emit one `stall_escalated` event (flight
+# dump + sink event) and go quiet for the episode. 0 = off (one fire
+# per stall episode, never terminal). The elastic supervisor
+# (elasticity/runtime.py) treats the escalated event as "stop waiting,
+# recover from the last committed checkpoint".
+MONITOR_STALL_ESCALATE_AFTER = "stall_escalate_after"
+MONITOR_STALL_ESCALATE_AFTER_DEFAULT = 0
 MONITOR_ALL_RANKS = "all_ranks"
 MONITOR_ALL_RANKS_DEFAULT = False
 # MFU denominator override (FLOP/s per chip). 0 = auto: the chip's

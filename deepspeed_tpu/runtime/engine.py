@@ -2159,13 +2159,17 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         return snap
 
     def _write_checkpoint(self, save_dir, tag, snap, save_latest,
-                          commit_gate=None):
+                          commit_gate=None, writer=None):
         """Phase 2 (runs on the background writer thread under
         async_save): device_get the snapshot and serialize into a
         `<tag>.tmp` staging dir, fsync, atomically rename to `<tag>`,
         update `latest` LAST, then rotate per checkpoint.keep_last.
         `commit_gate` (from AsyncCheckpointWriter.submit) orders the
-        commit sections of concurrent writers by submission."""
+        commit sections of concurrent writers by submission. `writer`
+        is the owning AsyncCheckpointWriter: a job whose writer was
+        ABANDONED still commits its tag dir but skips the `latest`
+        update and rotation (it may be racing a successor engine that
+        already committed newer tags)."""
         import time as _time
         write_t0 = _time.perf_counter()
         self.monitor.heartbeat("checkpoint")
@@ -2222,10 +2226,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
               else contextlib.nullcontext()):
             if jax.process_index() == 0:
                 ckpt_io.commit_staging_dir(save_dir, tag)
-                if save_latest:
+                stale = writer is not None and writer.abandoned.is_set()
+                if stale:
+                    logger.warning(
+                        f"abandoned checkpoint writer committed tag "
+                        f"'{tag}' but is leaving `latest` and rotation "
+                        "alone (a successor engine may own them now)")
+                if save_latest and not stale:
                     write_latest_tag(save_dir, tag)
                 keep_last = self.checkpoint_keep_last()
-                if keep_last:
+                if keep_last and not stale:
                     deleted = ckpt_io.rotate_checkpoints(
                         save_dir, keep_last, protect=(tag,))
                     if deleted:
@@ -2260,6 +2270,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._sync_scheduler_mirror()
         if tag is None:
             tag = f"global_step{self.global_steps}"
+        # a still-running ABANDONED writer may own this tag's shared
+        # `<tag>.tmp` staging dir (recovery replays regenerate the
+        # same tag names); writing into it concurrently would commit a
+        # torn mix of two saves — skip, the next boundary's tag is free
+        for w in list(getattr(self, "_abandoned_ckpt_writers", [])):
+            if not w.pending():
+                self._abandoned_ckpt_writers.remove(w)
+            elif w.tag_in_flight(tag):
+                logger.warning(
+                    f"skipping checkpoint save '{tag}': an abandoned "
+                    "writer still holds this tag's staging dir")
+                return False
         if self.checkpoint_tag_validation_enabled():
             validate_checkpoint_tag(
                 tag, fail_on_mismatch=self.checkpoint_tag_validation_fail())
@@ -2302,11 +2324,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         # exactly the window an OOM post-mortem needs attributed
         tokens = self._register_ckpt_snapshot(str(tag), snap)
         led = self.monitor.ledger
+        writer = self._ckpt_writer
         try:
-            accepted = self._ckpt_writer.submit(
+            accepted = writer.submit(
                 lambda commit_gate: self._write_checkpoint(
                     save_dir, str(tag), snap, save_latest,
-                    commit_gate=commit_gate),
+                    commit_gate=commit_gate, writer=writer),
                 tag,
                 on_done=lambda: [led.release(t) for t in tokens])
         except BaseException:
@@ -2351,23 +2374,91 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 space=_mem.SPACE_HOST))
         return tokens
 
-    def wait_for_checkpoint(self):
+    def wait_for_checkpoint(self, timeout=None):
         """Barrier for in-flight async saves: returns once every
         submitted checkpoint is durably committed (staging dir renamed,
         `latest` updated) and re-raises the first background write
         error. load_checkpoint calls this implicitly; call it yourself
-        before shutdown or before reading checkpoints externally."""
-        if self._ckpt_writer is not None:
-            self._ckpt_writer.wait()
+        before shutdown or before reading checkpoints externally.
+
+        `timeout` (seconds) bounds the wait: on expiry a
+        `CheckpointWaitTimeout` is raised carrying the writer's last
+        heartbeat age, so a supervisor can abandon a hung writer
+        (`abandon_checkpoint_writers`) and rebuild instead of blocking
+        teardown on it. (Writer threads stay non-daemon by design —
+        the interpreter never exits mid-write — so abandonment frees
+        the ENGINE, not final process exit, from a wedged writer.)"""
+        if self._ckpt_writer is None:
+            return
+        if self._ckpt_writer.wait(timeout):
+            return
+        hb, _ = self.monitor._heartbeat_state()
+        age = hb.get("checkpoint")
+        pending = self._ckpt_writer.pending()
+        raise ckpt_io.CheckpointWaitTimeout(
+            f"{pending} async checkpoint save(s) still in flight after "
+            f"{timeout}s; writer heartbeat "
+            + (f"{age}s ago" if age is not None else "never seen")
+            + " — abandon_checkpoint_writers() detaches them (the "
+            "committed `latest` tag is unaffected)",
+            pending=pending, heartbeat_age_sec=age)
+
+    def abandon_checkpoint_writers(self):
+        """Detach in-flight async save jobs: the engine stops tracking
+        (and waiting on) them. Running writer threads finish or fail
+        on their own — their tag dirs still commit atomically — but an
+        abandoned job no longer moves `latest` or rotates: a stale
+        writer unwedging AFTER a successor engine committed newer tags
+        must not regress the pointer to an older save. Their errors
+        are no longer re-raised into the train loop. Returns the
+        number of jobs abandoned. The next save_checkpoint builds a
+        fresh writer."""
+        writer, self._ckpt_writer = self._ckpt_writer, None
+        if writer is None:
+            return 0
+        writer.abandoned.set()
+        # remembered so later saves refuse to touch a tag whose
+        # staging dir a still-running abandoned job may own
+        self._abandoned_ckpt_writers = [
+            w for w in getattr(self, "_abandoned_ckpt_writers", [])
+            if w.pending()] + [writer]
+        abandoned = writer.pending()
+        if abandoned:
+            logger.warning(
+                f"abandoning {abandoned} in-flight async checkpoint "
+                "save(s); their tag dirs (if completed) remain atomic "
+                "but they will not move `latest`, and their errors "
+                "will no longer propagate")
+        return abandoned
+
+    def shutdown(self, wait_for_checkpoint=True,
+                 checkpoint_timeout=None):
+        """Tear down the engine's host-side services so it can be
+        dropped and rebuilt (the elastic supervisor's recovery path):
+        drain — or, on timeout, abandon — in-flight checkpoint writers,
+        then close the monitor (watchdog thread, flight recorder
+        disarm, sink flush). Device state is freed by GC once the last
+        reference to the engine goes away."""
+        if wait_for_checkpoint:
+            try:
+                self.wait_for_checkpoint(timeout=checkpoint_timeout)
+            except ckpt_io.CheckpointWaitTimeout as e:
+                logger.warning(f"shutdown: {e}")
+                self.abandon_checkpoint_writers()
+            except RuntimeError as e:
+                # a failed background write must not block teardown
+                logger.warning(f"shutdown: pending writer error: {e}")
+        self.monitor.close()
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_module_strict=True,
                         load_optimizer_states=True,
-                        load_lr_scheduler_states=True):
+                        load_lr_scheduler_states=True,
+                        retries=0):
         # a save of the checkpoint being loaded may still be in flight
         self.wait_for_checkpoint()
         if tag is None:
-            tag = read_latest_tag(load_dir)
+            tag = read_latest_tag(load_dir, retries=retries)
             if tag is None:
                 logger.warning(
                     f"Unable to find latest file at {load_dir}/latest")
@@ -2385,7 +2476,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             load_dir, tag, zero_enabled=load_optimizer_states,
             module_template=None if per_layer else self.state.params,
             opt_state_template=self.state.opt_state,
-            aux_templates=aux_templates)
+            aux_templates=aux_templates, retries=retries)
         if per_layer and "module" not in sd:
             # template/conversion hooks: engines whose stored layout
             # differs from the module's logical tree (PipelineEngine's

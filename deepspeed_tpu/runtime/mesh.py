@@ -52,6 +52,45 @@ def build_mesh(mesh_config: Optional[dict] = None, devices=None) -> Mesh:
     return Mesh(dev_array, AXIS_ORDER)
 
 
+def host_device_groups(devices=None, num_hosts=1):
+    """Split a device list into `num_hosts` contiguous "host" groups —
+    the virtual-mesh analog of TPU hosts owning a fixed chip subset
+    (on real hardware the grouping comes from device.process_index; on
+    the forced-host CPU mesh every device reports process 0, so the
+    contiguous split stands in). The elastic supervisor drops whole
+    groups when a host is lost."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    # ValueError, not assert: num_hosts comes from user config
+    # (elasticity.runtime.hosts) and must fail loudly under python -O
+    # too — a stripped divisibility check would silently drop devices
+    if not 1 <= num_hosts <= n:
+        raise ValueError(
+            f"num_hosts must be in [1, {n}], got {num_hosts}")
+    if n % num_hosts != 0:
+        raise ValueError(
+            f"device count {n} not divisible into {num_hosts} "
+            "host groups")
+    per = n // num_hosts
+    return [devices[i * per:(i + 1) * per] for i in range(num_hosts)]
+
+
+def reform_mesh(devices, mesh_config: Optional[dict] = None) -> Mesh:
+    """Re-form a mesh over an EXPLICIT surviving device list (elastic
+    recovery after host loss): same axis semantics as build_mesh, with
+    the data axis inferred from whatever devices remain unless the
+    config pins it. Raises on an empty survivor set rather than
+    building a zero-device mesh."""
+    devices = list(devices)
+    if not devices:
+        raise ValueError("cannot re-form a mesh over zero devices")
+    cfg = dict(mesh_config or {})
+    cfg.setdefault(DATA_AXIS, -1)
+    return build_mesh(cfg, devices=devices)
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 

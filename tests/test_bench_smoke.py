@@ -36,6 +36,7 @@ def test_bench_list_prints_legs():
     assert "fused_hot_loop" in legs and "pipe_interleave" in legs
     assert "monitor_overhead" in legs and "numerics_overhead" in legs
     assert "memory_ledger" in legs and "zero3_overlap" in legs
+    assert "elastic_recovery" in legs
 
 
 def test_bench_only_fused_hot_loop_leg():
@@ -214,6 +215,50 @@ def test_bench_only_zero3_overlap_leg():
     # catastrophic-regression bound only: the schedule must not make
     # the step dramatically slower than gather-everything-up-front
     assert result["overlap_speedup"] > 0.7, result
+
+
+def test_bench_only_elastic_recovery_leg():
+    """The elastic chaos leg (ISSUE 10) via `--only`, on an 8-device
+    virtual mesh: a SIGKILL'd sentinel host must be detected, the mesh
+    re-formed on the survivors (world 8 -> 4 with hosts=2), training
+    resumed from the last committed tag with the replayed-step loss
+    continuity assert exercised, and capacity return must grow back to
+    8 at a checkpoint boundary. The detection->resume wall time is the
+    leg's recorded metric; only its presence and a catastrophic bound
+    are asserted here (shared-box timing precedent)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"])
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; runpy.run_path("
+            f"{os.path.join(REPO, 'bench.py')!r}, run_name='__main__')")
+    proc = subprocess.run(
+        [sys.executable, "-c", code, "--only", "elastic_recovery"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "elastic_recovery"
+    result = d["result"]
+    assert "error" not in result, result
+    assert result["cause"] == "host_lost"
+    assert result["world_before"] == 8 and result["world_after"] == 4
+    assert result["resumed_from_tag"] == "global_step2"
+    assert result["replayed_steps"] >= 1
+    assert result["loss_continuity_checked"] is True
+    assert result["loss_continuity_ok"] is True
+    assert result["losses_finite"] is True
+    # detection->resume is the headline: present, positive, and not
+    # catastrophically slow even on a loaded shared box
+    assert 0 < result["detect_to_resume_ms"] < 120_000
+    assert result["kill_to_caught_up_ms"] > 0
+    # the re-planned ZeRO partition for the smaller world was recorded
+    assert result["zero_plan_bytes_after"]["opt_state"] > 0
+    # scale-up restored the original device count at a boundary
+    assert result["grow"]["world_restored"] == 8
+    assert result["grow"]["at_checkpoint_boundary"] is True
 
 
 def test_bench_only_unknown_leg_fails_with_list():
