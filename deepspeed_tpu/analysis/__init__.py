@@ -1,0 +1,84 @@
+"""ds_lint — static invariant analyzer for the deepspeed_tpu tree.
+
+Encodes the repo's hot-path, config, and event-schema contracts as
+CI-enforced lint rules (see docs/static-analysis.md for the catalog):
+
+  HOTSYNC   no host<->device sync reachable from a hot entrypoint
+            outside the declared fence sites
+  TRACECTL  no Python control flow on traced array values in
+            jit-traced functions
+  CFGKEY    config keys <-> runtime/constants.py <-> docs/MIGRATION.md
+            stay in sync, bidirectionally
+  EVTSCHEMA monitor event keys <-> docs/monitoring.md schema table
+  BROADEXC  broad except handlers re-raise, log the traceback, or are
+            explicitly annotated
+  LOCKBLOCK no blocking fs/queue work while holding a threading.Lock
+
+Run it as `bin/ds_lint <paths>` (also `tests/test_lint.py` runs it
+over the whole package in tier-1). Suppress a deliberate violation
+inline with `# ds-lint: allow[RULE] <reason>`; allowlist pre-existing
+findings with a baseline file (`--baseline`, default
+`.ds_lint_baseline.json` at the repo root).
+"""
+
+import dataclasses
+import os
+
+from deepspeed_tpu.analysis import core
+from deepspeed_tpu.analysis import registry as default_registry
+
+__all__ = ["run_analysis", "Context", "rule_names"]
+
+
+@dataclasses.dataclass
+class Context:
+    index: core.PackageIndex
+    registry: object
+    repo_root: str
+
+
+def rule_names():
+    from deepspeed_tpu.analysis.rules import ALL_RULES
+    return list(ALL_RULES)
+
+
+@dataclasses.dataclass
+class Result:
+    findings: list        # annotation-filtered, sorted
+    suppressed: list      # dropped by an inline allow annotation
+    errors: list          # (path, message) parse failures
+    index: object         # the PackageIndex (fingerprinting reuses it)
+    repo_root: str
+
+
+def run_analysis(paths, repo_root=None, registry=None, rules=None,
+                 base_dir=None):
+    """Run the analyzer over `paths` (package dirs or files).
+
+    Returns a Result. `registry` swaps the contract registry (fixture
+    tests declare their own hot entrypoints); `rules` restricts to a
+    subset of rule ids; `repo_root` anchors doc lookups (default:
+    parent of the first scanned path).
+    """
+    from deepspeed_tpu.analysis.rules import ALL_RULES
+    paths = [os.path.abspath(p) for p in paths]
+    if repo_root is None:
+        first = paths[0]
+        repo_root = os.path.dirname(first if os.path.isdir(first)
+                                    else os.path.dirname(first))
+    index = core.PackageIndex(paths, base_dir=base_dir)
+    ctx = Context(index=index,
+                  registry=registry or default_registry,
+                  repo_root=repo_root)
+    selected = rules if rules is not None else list(ALL_RULES)
+    findings, suppressed = [], []
+    for rid in selected:
+        for f in ALL_RULES[rid].check(ctx):
+            mod = index.by_path.get(os.path.abspath(f.path))
+            if mod is not None and mod.allows_rule(f.rule, f.line):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    errors = list(getattr(index, "parse_errors", []))
+    return Result(findings, suppressed, errors, index, repo_root)

@@ -1,0 +1,180 @@
+"""ds_lint command line.
+
+  ds_lint deepspeed_tpu/                 lint the package (text output)
+  ds_lint deepspeed_tpu/ --json          machine-readable findings
+  ds_lint --explain HOTSYNC              rule catalog entry
+  ds_lint --list-rules                   one line per rule
+  ds_lint pkg/ --baseline FILE           explicit baseline
+  ds_lint pkg/ --update-baseline         rewrite the baseline from
+                                         the current findings
+
+Exit codes: 0 clean (or all findings baselined), 1 new findings (or
+unparseable files), 2 usage error. The default baseline is
+`.ds_lint_baseline.json` next to the scanned package (the repo root),
+picked up automatically when it exists.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+from deepspeed_tpu import analysis
+from deepspeed_tpu.analysis import baseline as baseline_mod
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="ds_lint",
+        description="static invariant analyzer for deepspeed_tpu "
+                    "(rule catalog: docs/static-analysis.md)")
+    p.add_argument("paths", nargs="*", help="package dirs/files to lint")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: .ds_lint_baseline.json "
+                        "next to the scanned package, if present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset (e.g. "
+                        "HOTSYNC,BROADEXC)")
+    p.add_argument("--explain", metavar="RULE", default=None,
+                   help="print a rule's catalog entry and exit")
+    p.add_argument("--list-rules", action="store_true",
+                   help="list rules and exit")
+    return p
+
+
+def _package_root(path):
+    """Topmost enclosing directory that is still a package (has an
+    __init__.py); the path itself (or its directory) otherwise."""
+    d = os.path.abspath(path)
+    if not os.path.isdir(d):
+        d = os.path.dirname(d)
+    top = d
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        top = d
+        d = os.path.dirname(d)
+    return top
+
+
+def _under_requested(path, requested):
+    path = os.path.abspath(path)
+    for req in requested:
+        if path == req or path.startswith(req.rstrip(os.sep) + os.sep):
+            return True
+    # doc-side findings (docs/MIGRATION.md, docs/monitoring.md) are
+    # part of every scope — they have no .py home to filter by
+    return not path.endswith(".py")
+
+
+def main(argv=None):
+    from deepspeed_tpu.analysis.rules import ALL_RULES
+    args = _build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid, mod in ALL_RULES.items():
+            print(f"{rid:10s} {mod.SUMMARY}")
+        return 0
+    if args.explain:
+        mod = ALL_RULES.get(args.explain.upper())
+        if mod is None:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{', '.join(ALL_RULES)}", file=sys.stderr)
+            return 2
+        print(f"{mod.RULE} — {mod.SUMMARY}\n")
+        print(mod.EXPLAIN.strip())
+        return 0
+    if not args.paths:
+        print("ds_lint: no paths given (try: ds_lint deepspeed_tpu/)",
+              file=sys.stderr)
+        return 2
+    rules = None
+    if args.rules:
+        rules = [r.strip().upper() for r in args.rules.split(",")
+                 if r.strip()]
+        unknown = [r for r in rules if r not in ALL_RULES]
+        if unknown:
+            print(f"ds_lint: unknown rule(s) {unknown}; known: "
+                  f"{', '.join(ALL_RULES)}", file=sys.stderr)
+            return 2
+
+    # rules are whole-package contracts (call-graph reachability,
+    # registry resolution, doc cross-checks): widen any sub-path to
+    # its owning package root, analyze that, then report only the
+    # findings under the paths the user asked about
+    requested = [os.path.abspath(p) for p in args.paths]
+    roots = []
+    for p in requested:
+        root = _package_root(p)
+        if root not in roots:
+            roots.append(root)
+    repo_root = os.path.dirname(roots[0])
+    result = analysis.run_analysis(roots, repo_root=repo_root,
+                                   rules=rules)
+    # baseline bookkeeping always runs against the FULL package
+    # findings — applying/rewriting it from a scope-filtered subset
+    # would mark out-of-scope entries expired (or truncate the shared
+    # baseline on --update-baseline); only the report is scoped
+    findings = result.findings
+    suppressed, errors = result.suppressed, result.errors
+    index = result.index
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        cand = baseline_mod.default_path(repo_root)
+        baseline_path = cand if os.path.exists(cand) or \
+            args.update_baseline else None
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.update_baseline:
+        if baseline_path is None:
+            baseline_path = baseline_mod.default_path(repo_root)
+        entries = baseline_mod.build_entries(findings, index, repo_root)
+        baseline_mod.save(baseline_path, entries)
+        print(f"ds_lint: baseline written: {len(entries)} finding(s) "
+              f"-> {os.path.relpath(baseline_path)}")
+        return 0
+
+    entries = baseline_mod.load(baseline_path) if baseline_path else {}
+    new, baselined, expired = baseline_mod.apply(
+        findings, entries, index, repo_root)
+    # scope the REPORT (and exit code) to the requested paths
+    new = [f for f in new if _under_requested(f.path, requested)]
+
+    if args.as_json:
+        doc = {
+            "findings": [f.as_dict(repo_root) for f in new],
+            "baselined": len(baselined),
+            "suppressed": len(suppressed),
+            "expired_baseline": sorted(expired),
+            "errors": [{"path": p, "error": e} for p, e in errors],
+        }
+        print(json.dumps(doc, indent=1))
+    else:
+        for f in new:
+            print(f"{f.location(repo_root)}: {f.rule} "
+                  f"[{f.qualname or '<module>'}] {f.message}")
+        for p, e in errors:
+            print(f"{os.path.relpath(p, repo_root)}: PARSE-ERROR {e}")
+        tail = (f"ds_lint: {len(new)} finding(s)"
+                f" ({len(baselined)} baselined,"
+                f" {len(suppressed)} annotated)")
+        if expired:
+            tail += (f"; {len(expired)} expired baseline entr"
+                     f"{'y' if len(expired) == 1 else 'ies'} — run "
+                     "--update-baseline to prune")
+            for fp in sorted(expired):
+                rec = expired[fp]
+                print(f"  expired: [{rec.get('rule')}] "
+                      f"{rec.get('location')} {fp}")
+        print(tail)
+    return 1 if (new or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

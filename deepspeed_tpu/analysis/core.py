@@ -1,0 +1,447 @@
+"""ds_lint analyzer core: module indexing + call-graph reachability.
+
+Everything here is stdlib `ast` — no runtime import of the analyzed
+package, so the analyzer runs in CI before a TPU (or even jax) is
+available. The core builds:
+
+  * a `PackageIndex` over every `.py` file under the scanned roots:
+    functions by dotted qualname (nested functions appear as
+    `outer.<locals>.inner`), classes with their base-class names,
+    per-module import tables, and per-line `# ds-lint: allow[RULE]`
+    annotations;
+  * an intra-package call-graph resolver (`resolve_calls`) covering
+    the idioms the repo actually uses: bare names, `module.func`,
+    `self.method` through the package-local class hierarchy, and
+    `self.<attr>.method` through the declared attribute-type hints in
+    `analysis/registry.py` (e.g. `engine.monitor` is a
+    `monitor.Monitor`);
+  * `reachable()` — BFS over that graph from a set of declared
+    entrypoints, stopping at declared fence sites. This is what lets
+    HOTSYNC say "no sync reachable from the hot loop" statically, the
+    same shape as the dynamic guard tests' monkeypatched counters.
+
+The resolver is deliberately conservative: an attribute call it cannot
+resolve is simply not traversed (no false edges), which means rules
+built on reachability under-approximate rather than spray false
+positives. The fence-site cross-check test (`tests/test_lint.py`)
+guards the other direction: every declared fence site must exist and
+must actually contain a sync call.
+"""
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+
+ALLOW_RE = re.compile(
+    r"#\s*ds-lint:\s*allow\[([A-Za-z0-9_,\s]+)\]\s*(.*)")
+
+LOCALS_MARK = "<locals>"
+
+
+# ----------------------------------------------------------------------
+# findings
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # absolute path of the offending file
+    line: int
+    qualname: str      # enclosing function/class qualname ("" = module)
+    message: str
+    col: int = 0
+
+    def location(self, root=None):
+        p = os.path.relpath(self.path, root) if root else self.path
+        return f"{p}:{self.line}"
+
+    def fingerprint(self, root=None, source_line=""):
+        """Stable identity for baselining: rule + relative path +
+        enclosing qualname + the normalized source line text. Line
+        NUMBERS are deliberately excluded so unrelated edits above a
+        baselined finding don't expire it."""
+        p = os.path.relpath(self.path, root) if root else \
+            os.path.basename(self.path)
+        text = re.sub(r"\s+", " ", source_line).strip()
+        raw = "|".join((self.rule, p.replace(os.sep, "/"),
+                        self.qualname, text))
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def as_dict(self, root=None):
+        return {"rule": self.rule, "path": self.location(root),
+                "line": self.line, "qualname": self.qualname,
+                "message": self.message}
+
+
+# ----------------------------------------------------------------------
+# per-module index
+# ----------------------------------------------------------------------
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str            # dotted module name
+    qualname: str          # e.g. "DeepSpeedEngine.train_batch"
+    node: object           # ast.FunctionDef / AsyncFunctionDef
+    path: str
+    class_name: str = ""   # innermost enclosing class ("" = free fn)
+
+    @property
+    def key(self):
+        return f"{self.module}:{self.qualname}"
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    module: str
+    name: str
+    bases: tuple           # base-class NAME strings as written
+
+
+class ModuleInfo:
+    def __init__(self, name, path, source):
+        self.name = name
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.functions = {}     # qualname -> FunctionInfo
+        self.classes = {}       # class name -> ClassInfo
+        self.imports = {}       # local alias -> dotted module
+        self.from_imports = {}  # local name -> (dotted module, orig name)
+        self.allows = {}        # lineno -> set of rule names
+        self._index()
+        self._scan_allows()
+
+    def _scan_allows(self):
+        for i, text in enumerate(self.lines, start=1):
+            m = ALLOW_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.allows[i] = rules
+
+    def allows_rule(self, rule, lineno):
+        """An annotation suppresses a finding on its own line or on
+        the line directly below it (annotation-above style)."""
+        for ln in (lineno, lineno - 1):
+            if rule in self.allows.get(ln, ()):
+                return True
+        return False
+
+    def _index(self):
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack = []      # qualname segments
+                self.class_stack = []
+
+            def visit_Import(self, node):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+
+            def visit_ImportFrom(self, node):
+                if node.module is None:
+                    return
+                src = node.module
+                if node.level:
+                    # relative import: resolve against this module
+                    parts = mod.name.split(".")
+                    base = parts[:len(parts) - node.level]
+                    src = ".".join(base + ([node.module]
+                                           if node.module else []))
+                for a in node.names:
+                    mod.from_imports[a.asname or a.name] = (src, a.name)
+
+            def visit_ClassDef(self, node):
+                bases = tuple(
+                    b.id if isinstance(b, ast.Name) else
+                    (b.attr if isinstance(b, ast.Attribute) else "")
+                    for b in node.bases)
+                mod.classes[node.name] = ClassInfo(mod.name, node.name,
+                                                   bases)
+                self.stack.append(node.name)
+                self.class_stack.append(node.name)
+                self.generic_visit(node)
+                self.class_stack.pop()
+                self.stack.pop()
+
+            def _visit_fn(self, node):
+                self.stack.append(node.name)
+                q = ".".join(self.stack)
+                mod.functions[q] = FunctionInfo(
+                    mod.name, q, node, mod.path,
+                    self.class_stack[-1] if self.class_stack else "")
+                self.stack.append(LOCALS_MARK)
+                self.generic_visit(node)
+                self.stack.pop()
+                self.stack.pop()
+
+            visit_FunctionDef = _visit_fn
+            visit_AsyncFunctionDef = _visit_fn
+
+        V().visit(self.tree)
+
+
+# ----------------------------------------------------------------------
+# package index
+# ----------------------------------------------------------------------
+class PackageIndex:
+    """Parsed view of every module under the scanned roots."""
+
+    def __init__(self, roots, base_dir=None):
+        self.modules = {}        # dotted name -> ModuleInfo
+        self.by_path = {}        # abs path -> ModuleInfo
+        self.base_dir = base_dir
+        for root in roots:
+            root = os.path.abspath(root)
+            if os.path.isfile(root):
+                self._add_file(root, base_dir or os.path.dirname(root))
+            else:
+                for dirpath, dirnames, filenames in os.walk(root):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            self._add_file(
+                                os.path.join(dirpath, fn),
+                                base_dir or os.path.dirname(root))
+
+    def _add_file(self, path, base):
+        rel = os.path.relpath(path, base)
+        name = rel[:-3].replace(os.sep, ".")
+        if name.endswith(".__init__"):
+            name = name[:-len(".__init__")]
+        try:
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            self.modules[name] = m = ModuleInfo(name, path, src)
+            self.by_path[os.path.abspath(path)] = m
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # a file the analyzer cannot parse is itself a finding for
+            # the CLI layer; record it rather than crash the run
+            self.parse_errors = getattr(self, "parse_errors", [])
+            self.parse_errors.append((path, str(e)))
+
+    # ------------------------------------------------------------------
+    def function(self, key):
+        """Look up "dotted.module:Qual.name"; follows inheritance for
+        "Class.method" entries where the class doesn't define it."""
+        mod_name, _, qual = key.partition(":")
+        mod = self.modules.get(mod_name)
+        if mod is None:
+            return None
+        fn = mod.functions.get(qual)
+        if fn is not None:
+            return fn
+        if "." in qual:
+            cls, _, meth = qual.partition(".")
+            return self._method_on_class(mod, cls, meth)
+        return None
+
+    def _resolve_class(self, mod, name):
+        """Find a ClassInfo by name as visible from `mod`."""
+        if name in mod.classes:
+            return mod.classes[name], mod
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            src_mod = self.modules.get(src)
+            if src_mod and orig in src_mod.classes:
+                return src_mod.classes[orig], src_mod
+        return None, None
+
+    def _method_on_class(self, mod, cls_name, meth, _seen=None):
+        _seen = _seen or set()
+        if (mod.name, cls_name) in _seen:
+            return None
+        _seen.add((mod.name, cls_name))
+        ci, owner = self._resolve_class(mod, cls_name)
+        if ci is None:
+            return None
+        fn = owner.functions.get(f"{cls_name}.{meth}")
+        if fn is not None:
+            return fn
+        for base in ci.bases:
+            got = self._method_on_class(owner, base, meth, _seen)
+            if got is not None:
+                return got
+        return None
+
+    # ------------------------------------------------------------------
+    # call resolution
+    # ------------------------------------------------------------------
+    def resolve_calls(self, fn, attr_types=None):
+        """Yield FunctionInfo targets for every call syntactically
+        inside `fn` (but not inside its nested function defs)."""
+        mod = self.modules[fn.module]
+        attr_types = attr_types or {}
+        for call in self._own_calls(fn):
+            tgt = self._resolve_one(call, fn, mod, attr_types)
+            if tgt is not None:
+                yield call, tgt
+
+    def _own_calls(self, fn):
+        """Call nodes belonging to fn itself (nested defs excluded —
+        they are separate FunctionInfos)."""
+        out = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    out.append(child)
+                walk(child)
+
+        walk(fn.node)
+        return out
+
+    def _resolve_one(self, call, fn, mod, attr_types):
+        f = call.func
+        if isinstance(f, ast.Name):
+            # nested sibling or same-scope local function first
+            prefix = fn.qualname + f".{LOCALS_MARK}."
+            cand = mod.functions.get(prefix + f.id)
+            if cand is not None:
+                return cand
+            cand = mod.functions.get(f.id)
+            if cand is not None:
+                return cand
+            if fn.class_name:
+                cand = mod.functions.get(f"{fn.class_name}.{f.id}")
+                if cand is not None:
+                    return cand
+            if f.id in mod.from_imports:
+                src, orig = mod.from_imports[f.id]
+                src_mod = self.modules.get(src)
+                if src_mod:
+                    return src_mod.functions.get(orig)
+            return None
+        if isinstance(f, ast.Attribute):
+            parts = _attr_parts(f)
+            if parts is None:
+                return None
+            root, rest = parts[0], parts[1:]
+            if root == "self" and fn.class_name:
+                if len(rest) == 1:
+                    return self._method_on_class(mod, fn.class_name,
+                                                 rest[0])
+                # self.<attr-chain>.method through declared type hints
+                return self._via_attr_types(rest, attr_types)
+            if root in mod.imports:
+                src_mod = self.modules.get(mod.imports[root])
+                if src_mod and len(rest) == 1:
+                    return src_mod.functions.get(rest[0])
+                if src_mod and len(rest) == 2:
+                    return self._method_on_class(src_mod, rest[0],
+                                                 rest[1])
+            if root in mod.from_imports and rest:
+                src, orig = mod.from_imports[root]
+                tgt = self.modules.get(f"{src}.{orig}") or \
+                    self.modules.get(src)
+                if tgt and len(rest) == 1:
+                    return tgt.functions.get(rest[0])
+            # bare-name object with a declared type hint
+            # (e.g. `loader.put(...)` where loader: PrefetchLoader)
+            return self._via_attr_types([root] + rest, attr_types)
+        return None
+
+    def _via_attr_types(self, chain, attr_types):
+        """chain = [attr, ..., method]; find the longest declared
+        prefix in attr_types (e.g. "monitor.trace") and resolve the
+        method on the mapped class."""
+        if len(chain) < 2:
+            return None
+        meth = chain[-1]
+        attrs = chain[:-1]
+        for cut in range(len(attrs), 0, -1):
+            key = ".".join(attrs[:cut])
+            hint = attr_types.get(key)
+            if hint is None:
+                continue
+            mod_name, _, cls = hint.partition(":")
+            mod = self.modules.get(mod_name)
+            if mod is None:
+                return None
+            if cut < len(attrs):
+                # unresolved middle segment — give up (conservative)
+                return None
+            return self._method_on_class(mod, cls, meth)
+        return None
+
+    # ------------------------------------------------------------------
+    def reachable(self, entry_keys, stop_keys=(), attr_types=None):
+        """BFS closure of FunctionInfos reachable from entry_keys via
+        resolvable intra-package calls, never traversing INTO any
+        function named in stop_keys (fence sites). Entries that don't
+        resolve are returned in `missing` so the caller can fail
+        loudly instead of silently shrinking coverage."""
+        stop = set(stop_keys)
+        seen, order, missing = set(), [], []
+        work = []
+        for k in entry_keys:
+            fi = self.function(k)
+            if fi is None:
+                missing.append(k)
+            elif fi.key not in seen:
+                seen.add(fi.key)
+                work.append(fi)
+        while work:
+            fi = work.pop()
+            order.append(fi)
+            for _call, tgt in self.resolve_calls(fi, attr_types):
+                if tgt is None or tgt.key in seen:
+                    continue
+                if _matches_any(tgt, stop):
+                    continue
+                seen.add(tgt.key)
+                work.append(tgt)
+        return order, missing
+
+
+def _matches_any(fn, keys):
+    if fn.key in keys:
+        return True
+    # allow stop entries declared against the defining CLASS of an
+    # inherited method ("Class.method" written for a subclass)
+    return any(k.endswith(":" + fn.qualname) and
+               k.partition(":")[0] == fn.module for k in keys)
+
+
+def _attr_parts(node):
+    """`a.b.c` -> ["a","b","c"]; None when the chain roots in a call
+    or subscript (not resolvable)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return None
+
+
+def attr_chain_str(node):
+    parts = _attr_parts(node)
+    return ".".join(parts) if parts else None
+
+
+def enclosing_qualname(mod, lineno):
+    """Innermost function qualname containing a line (best effort,
+    for finding labels)."""
+    best, best_span = "", None
+    for q, fi in mod.functions.items():
+        end = getattr(fi.node, "end_lineno", fi.node.lineno)
+        if fi.node.lineno <= lineno <= end:
+            span = end - fi.node.lineno
+            if best_span is None or span < best_span:
+                best, best_span = q, span
+    return best
+
+
+def source_line(mod, lineno):
+    if 1 <= lineno <= len(mod.lines):
+        return mod.lines[lineno - 1]
+    return ""

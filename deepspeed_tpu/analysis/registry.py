@@ -1,0 +1,165 @@
+"""ds_lint contract registry: the repo's declared hot entrypoints,
+fence sites, and attribute-type hints.
+
+This file IS the contract. The dynamic guard tests
+(`test_async_dispatch.py::test_hot_path_has_zero_host_syncs`,
+`test_monitor.py::test_monitor_fence_costs_exactly_one_device_get`,
+`test_numerics.py`, `test_zero3_runtime.py`) pin the same invariant at
+runtime with monkeypatched sync counters; `tests/test_lint.py`
+cross-checks that the two stay in sync. When you add a new jitted step
+builder or a new deliberate sync point:
+
+  1. add the builder to HOT_ENTRYPOINTS (new hot code becomes covered);
+  2. if it introduces a deliberate rendezvous, add that function to
+     FENCE_SITES *and* extend the dynamic guard test that measures the
+     fence cost — the cross-check test fails until both exist.
+
+Entries are "dotted.module:Qualified.name" strings resolved against the
+scanned tree (inheritance-aware: a method declared on the defining
+class covers subclasses). A HOT entry that no longer resolves is a
+lint ERROR (the registry must not rot), reported as rule REGISTRY.
+"""
+
+# ----------------------------------------------------------------------
+# HOTSYNC: hot entrypoints — the per-step loop + the jitted step
+# builders. Everything statically reachable from these (minus fence
+# sites) must stay free of host<->device syncs.
+# ----------------------------------------------------------------------
+HOT_ENTRYPOINTS = (
+    # engine hot loop (fused path + legacy forward/backward/step)
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine.train_batch",
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine.forward",
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine.backward",
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine.step",
+    # jitted step builders: their inner functions are traced — a sync
+    # inside one fires at trace time and wedges every later step
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine._build_step_fns",
+    "deepspeed_tpu.runtime.engine:"
+    "DeepSpeedEngine._build_onebit_compressed_step",
+    "deepspeed_tpu.runtime.pipe.engine:PipelineEngine._train_batch_impl",
+    "deepspeed_tpu.runtime.pipe.engine:PipelineEngine._build_step_fns",
+    "deepspeed_tpu.runtime.zero.offload:"
+    "ZeroOffloadMixin._build_offload_fns",
+    "deepspeed_tpu.runtime.zero.stage3:Zero3GatherScheduler.apply_layers",
+    "deepspeed_tpu.runtime.zero.stage3:Zero3GatherScheduler.gather",
+    "deepspeed_tpu.ops.transformer.fused_ops:"
+    "fused_bias_residual_layernorm",
+    "deepspeed_tpu.ops.transformer.fused_ops:fused_bias_gelu",
+)
+
+# ----------------------------------------------------------------------
+# HOTSYNC: fence sites — the declared host<->device rendezvous points.
+# Syncs inside these are the contract (one fused fetch per fence);
+# traversal stops here. Keep this list in lockstep with the dynamic
+# guard tests (see module docstring).
+# ----------------------------------------------------------------------
+FENCE_SITES = (
+    # the engine's only hot-loop rendezvous (PR 2): drains metrics,
+    # refreshes the scheduler mirror, logs
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine._sync_fence",
+    "deepspeed_tpu.runtime.engine:DeepSpeedEngine._sync_scheduler_mirror",
+    # the monitor's one-device_get-per-fence drain (PR 7)
+    "deepspeed_tpu.monitor:Monitor.on_fence",
+    "deepspeed_tpu.monitor.registry:MetricsRegistry.drain_device",
+    # ZeRO-Offload host optimizer step: inherently synchronous (async
+    # dispatch is forced off under offload) — the D2H/H2D round trip
+    # IS the design (PR 5)
+    "deepspeed_tpu.runtime.zero.offload:ZeroOffloadMixin._offload_take_step",
+    # throughput-timer barrier: fences only at report boundaries (the
+    # per-step form was removed in PR 2; the dynamic guard tests would
+    # catch it coming back per-step)
+    "deepspeed_tpu.utils.timer:_device_sync",
+)
+
+# ----------------------------------------------------------------------
+# attribute-type hints for `self.<attr>.method()` resolution.
+# Key: attribute chain as written after `self.` (or a bare local
+# object name); value: "dotted.module:ClassName".
+# ----------------------------------------------------------------------
+ATTR_TYPES = {
+    "monitor": "deepspeed_tpu.monitor:Monitor",
+    "monitor.trace": "deepspeed_tpu.monitor.trace:StepTrace",
+    "monitor.watchdog": "deepspeed_tpu.monitor.watchdog:StallWatchdog",
+    "monitor.flight": "deepspeed_tpu.monitor.flight:FlightRecorder",
+    "registry": "deepspeed_tpu.monitor.registry:MetricsRegistry",
+    "trace": "deepspeed_tpu.monitor.trace:StepTrace",
+    "flight": "deepspeed_tpu.monitor.flight:FlightRecorder",
+    "watchdog": "deepspeed_tpu.monitor.watchdog:StallWatchdog",
+    "ledger": "deepspeed_tpu.monitor.memory:MemoryLedger",
+    "tput_timer": "deepspeed_tpu.utils.timer:ThroughputTimer",
+    "_scheduler": "deepspeed_tpu.runtime.zero.stage3:Zero3GatherScheduler",
+}
+
+# ----------------------------------------------------------------------
+# HOTSYNC: the host<->device sync surface. Any call whose final
+# attribute (or bare imported name) is one of these counts as a sync.
+# ----------------------------------------------------------------------
+SYNC_CALL_NAMES = frozenset({
+    "device_get",          # jax.device_get
+    "block_until_ready",   # jax.block_until_ready / arr.block_until_ready
+    "effects_barrier",     # jax.effects_barrier
+    "process_allgather",   # multihost fetch
+    "item",                # arr.item()
+})
+
+# float()/int()/bool()/np.asarray()/np.array() applied to a value the
+# local dataflow marks device-resident (assigned from a `*_jit` call,
+# a jnp/jax/lax call, or an attribute path through `.state.`)
+HOST_CONVERSIONS = frozenset({"float", "int", "bool"})
+NP_CONVERSIONS = frozenset({"asarray", "array"})
+
+# ----------------------------------------------------------------------
+# LOCKBLOCK: calls that block (or do filesystem-durability work) and
+# therefore must not run while holding a threading.Lock in the
+# monitor/checkpoint thread paths. Attribute forms additionally
+# require an os/shutil/time receiver (so `str.replace` is not
+# `os.replace`). `.join()`/`.wait()` are deliberately NOT listed:
+# without type information `", ".join(...)` and `Condition.wait()`
+# (whose whole point is waiting under its lock) are indistinguishable
+# from the thread-join deadlock shape.
+# ----------------------------------------------------------------------
+BLOCKING_CALL_NAMES = frozenset({
+    "fsync", "replace", "rename", "rmtree", "sleep",
+})
+# queue ops count only when the receiver looks like a queue and no
+# block=False / timeout= escape hatch is passed
+QUEUE_CALL_NAMES = frozenset({"put", "get"})
+
+# ----------------------------------------------------------------------
+# TRACECTL: constructs that mark a function as jit-traced when it is
+# passed to them (by name) or decorated with them.
+# ----------------------------------------------------------------------
+TRACING_ENTRY_CALLS = frozenset({
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad",
+    "custom_vjp", "checkpoint", "remat", "shard_map", "pallas_call",
+    "scan", "while_loop", "cond", "switch", "fori_loop",
+})
+
+# ----------------------------------------------------------------------
+# CFGKEY: where config key constants are declared, and doc files a
+# read key must appear in.
+# ----------------------------------------------------------------------
+CONFIG_CONSTANT_MODULES = (
+    "deepspeed_tpu.runtime.constants",
+    "deepspeed_tpu.runtime.zero.config",
+)
+CONFIG_DOC_FILES = ("docs/MIGRATION.md",)
+# receivers whose .get("literal") / ["literal"] access counts as a
+# config read (dict-shaped config objects)
+CONFIG_RECEIVER_RE = r"(param_dict|config_dict|_pd)$"
+
+# ----------------------------------------------------------------------
+# EVTSCHEMA: the machine-readable event-schema table in the docs.
+# ----------------------------------------------------------------------
+# modules whose dict-building code is scanned for emitted events
+EVENT_EMITTER_MODULE_PREFIXES = (
+    "deepspeed_tpu.monitor",
+    "deepspeed_tpu.elasticity",
+    "deepspeed_tpu.runtime.engine",
+    "deepspeed_tpu.runtime.checkpoint",
+)
+EVENT_SCHEMA_DOC = "docs/monitoring.md"
+EVENT_SCHEMA_BEGIN = "<!-- ds-lint:event-schema:begin -->"
+EVENT_SCHEMA_END = "<!-- ds-lint:event-schema:end -->"
+# keys every event carries via sinks.base_event — implicit, not listed
+EVENT_BASE_KEYS = frozenset({"v", "ts", "kind", "step"})

@@ -1,0 +1,22 @@
+"""ds_lint rule registry.
+
+Each rule module exposes:
+  RULE     — the rule id (the name used in `# ds-lint: allow[RULE]`)
+  SUMMARY  — one line for `ds_lint --list-rules`
+  EXPLAIN  — the `--explain RULE` catalog text
+  check(ctx) -> list[core.Finding]
+
+`ctx` is analysis.Context: the parsed PackageIndex, the contract
+registry (swappable so fixture tests can declare their own hot
+entrypoints), and the repo root for doc lookups. Findings suppressed
+by an inline annotation are dropped centrally in analysis.run_analysis,
+not per rule.
+"""
+
+from deepspeed_tpu.analysis.rules import (broadexc, cfgkey, evtschema,
+                                          hotsync, lockblock, tracectl)
+
+ALL_RULES = {
+    m.RULE: m
+    for m in (hotsync, tracectl, cfgkey, evtschema, broadexc, lockblock)
+}

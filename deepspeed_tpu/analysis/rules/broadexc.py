@@ -1,0 +1,93 @@
+"""BROADEXC — broad exception handlers must not swallow silently.
+
+`except Exception:` (or a bare `except:`) in a background thread body
+is how a dead checkpoint writer, a wedged watchdog, or a crashed
+monitor sink goes unnoticed for an hour of burned TPU time. A broad
+handler must do one of:
+
+  * re-raise (any `raise` in the handler body);
+  * log WITH the traceback — `logger.exception(...)`, any logging
+    call with `exc_info=...`, or a handler that formats
+    `traceback.format_exc()` / `print_exc()` into its message;
+  * carry an explicit annotation on the `except` line:
+        except Exception:  # ds-lint: allow[BROADEXC] <why this is ok>
+    for the genuinely-intentional swallows (e.g. "a post-mortem dump
+    must never raise out of a signal handler").
+
+A `logger.warning(f"... {e}")` without the traceback does NOT count:
+it names the failure but destroys the evidence.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis import core
+
+RULE = "BROADEXC"
+SUMMARY = ("broad `except Exception` must re-raise, log with "
+           "traceback, or carry an allow[BROADEXC] annotation")
+EXPLAIN = __doc__
+
+_TB_FUNCS = {"exception", "format_exc", "print_exc", "format_exception"}
+
+
+def check(ctx):
+    findings = []
+    for mod in ctx.index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            if _handles_properly(node):
+                continue
+            findings.append(core.Finding(
+                RULE, mod.path, node.lineno,
+                core.enclosing_qualname(mod, node.lineno),
+                "broad exception handler neither re-raises nor logs "
+                "the traceback — narrow the type, add "
+                "logger.exception()/exc_info=True, or annotate "
+                "`# ds-lint: allow[BROADEXC] <reason>`",
+                node.col_offset))
+    return findings
+
+
+def _is_broad(type_node):
+    if type_node is None:
+        return True     # bare except:
+    names = []
+    if isinstance(type_node, ast.Name):
+        names = [type_node.id]
+    elif isinstance(type_node, ast.Attribute):
+        names = [type_node.attr]
+    elif isinstance(type_node, ast.Tuple):
+        for el in type_node.elts:
+            if isinstance(el, ast.Name):
+                names.append(el.id)
+            elif isinstance(el, ast.Attribute):
+                names.append(el.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+def _handles_properly(handler):
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fname = node.func.attr if isinstance(
+                node.func, ast.Attribute) else (
+                node.func.id if isinstance(node.func, ast.Name)
+                else None)
+            if fname in _TB_FUNCS:
+                return True
+            for kw in node.keywords:
+                if kw.arg != "exc_info":
+                    continue
+                # exc_info=False is exactly the "names the failure,
+                # destroys the evidence" pattern — only a truthy (or
+                # non-constant, e.g. a variable) value counts
+                if not (isinstance(kw.value, ast.Constant) and
+                        not kw.value.value):
+                    return True
+        if isinstance(node, ast.Attribute) and node.attr in _TB_FUNCS:
+            return True
+    return False

@@ -1,0 +1,168 @@
+"""CFGKEY — config keys, constants, and docs must agree.
+
+Every JSON config key the runtime reads must (a) be declared as a
+string constant in `runtime/constants.py` / `runtime/zero/config.py`
+(so defaults live in one place and `from constants import *` users see
+the full surface), and (b) appear in `docs/MIGRATION.md` (the config
+surface IS the migration contract). The check is bidirectional:
+
+  * a `get_scalar_param(block, "literal", ...)` or
+    `param_dict.get("literal")` read is a finding — declare the
+    constant and read through it;
+  * a key constant that is read in code but whose key string never
+    appears in docs/MIGRATION.md is a finding — add the doc row;
+  * a declared key constant referenced nowhere outside its defining
+    module is a finding — dead config surface, remove it (or wire it
+    up).
+"""
+
+import ast
+import os
+import re
+
+from deepspeed_tpu.analysis import core
+
+RULE = "CFGKEY"
+SUMMARY = ("config keys read in code must be declared constants with "
+           "a docs/MIGRATION.md row; no dead declared keys")
+EXPLAIN = __doc__
+
+_EXCLUDE_SUFFIXES = ("_DEFAULT", "_VALID", "_MODES", "_POLICIES",
+                     "_DEFAULTS")
+
+
+def check(ctx):
+    reg = ctx.registry
+    findings = []
+    const_mods = [ctx.index.modules[m]
+                  for m in reg.CONFIG_CONSTANT_MODULES
+                  if m in ctx.index.modules]
+    declared = {}      # NAME -> (value, ModuleInfo, lineno)
+    for mod in const_mods:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if len(node.targets) != 1 or \
+                    not isinstance(node.targets[0], ast.Name):
+                continue
+            name = node.targets[0].id
+            if not name.isupper() or \
+                    name.endswith(_EXCLUDE_SUFFIXES):
+                continue
+            if isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                declared[name] = (node.value.value, mod, node.lineno)
+
+    receiver_re = re.compile(reg.CONFIG_RECEIVER_RE)
+    read_consts = set()    # constant NAMEs read somewhere
+    referenced = set()     # NAMEs referenced anywhere outside declaration
+    const_paths = {m.path for m in const_mods}
+
+    for mod in ctx.index.modules.values():
+        for node in ast.walk(mod.tree):
+            # --- literal reads ---
+            if isinstance(node, ast.Call):
+                fname = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else (
+                    node.func.id if isinstance(node.func, ast.Name)
+                    else None)
+                if fname == "get_scalar_param" and len(node.args) >= 2:
+                    key = node.args[1]
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, str):
+                        findings.append(_literal_finding(
+                            mod, node, key.value))
+                    else:
+                        read_consts.update(_const_names(key))
+                elif fname == "get" and \
+                        isinstance(node.func, ast.Attribute) and \
+                        _config_receiver(node.func.value,
+                                         receiver_re) and node.args:
+                    key = node.args[0]
+                    if isinstance(key, ast.Constant) and \
+                            isinstance(key.value, str):
+                        findings.append(_literal_finding(
+                            mod, node, key.value))
+                    else:
+                        read_consts.update(_const_names(key))
+            elif isinstance(node, ast.Subscript) and \
+                    _config_receiver(node.value, receiver_re):
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and \
+                        isinstance(sl.value, str):
+                    findings.append(_literal_finding(mod, node,
+                                                     sl.value))
+                else:
+                    read_consts.update(_const_names(sl))
+            # --- references to declared constants ---
+            # a Load anywhere counts (including other constants'
+            # value expressions and the declaring module's own
+            # config classes); the declaration itself is a Store
+            if isinstance(node, ast.Name) and node.id in declared and \
+                    isinstance(node.ctx, ast.Load):
+                referenced.add(node.id)
+            elif isinstance(node, ast.Attribute) and \
+                    node.attr in declared and \
+                    isinstance(node.ctx, ast.Load):
+                referenced.add(node.attr)
+
+    # constants referenced inside the constants modules themselves
+    # (value lists, derived defaults) don't count as "read by the
+    # runtime" but DO count against deadness when another declared
+    # constant aliases them
+    doc_text = ""
+    for rel in reg.CONFIG_DOC_FILES:
+        p = os.path.join(ctx.repo_root, rel)
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                doc_text += f.read()
+
+    for name in sorted(read_consts & set(declared)):
+        value, mod, lineno = declared[name]
+        if not _documented(value, doc_text):
+            findings.append(core.Finding(
+                RULE, mod.path, lineno, "",
+                f"config key {value!r} ({name}) is read in code but "
+                f"has no row in {'/'.join(reg.CONFIG_DOC_FILES)} — "
+                "add it to the config-key reference"))
+
+    for name, (value, mod, lineno) in sorted(declared.items()):
+        if name not in referenced:
+            findings.append(core.Finding(
+                RULE, mod.path, lineno, "",
+                f"declared config key constant {name} = {value!r} is "
+                "never referenced outside its declaration — dead "
+                "config surface (remove it or wire it up)"))
+    return findings
+
+
+def _literal_finding(mod, node, key):
+    return core.Finding(
+        RULE, mod.path, node.lineno,
+        core.enclosing_qualname(mod, node.lineno),
+        f"config key {key!r} read via a string literal — declare a "
+        "constant in runtime/constants.py (or zero/config.py) and "
+        "read through it", getattr(node, "col_offset", 0))
+
+
+def _config_receiver(node, receiver_re):
+    if isinstance(node, ast.Attribute):
+        return bool(receiver_re.search(node.attr))
+    if isinstance(node, ast.Name):
+        return bool(receiver_re.search(node.id))
+    return False
+
+
+def _const_names(expr):
+    out = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute):
+            out.add(n.attr)
+        elif isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _documented(key, doc_text):
+    return re.search(r"(?<![A-Za-z0-9_])" + re.escape(key) +
+                     r"(?![A-Za-z0-9_])", doc_text) is not None

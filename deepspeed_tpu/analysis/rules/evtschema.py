@@ -1,0 +1,371 @@
+"""EVTSCHEMA — monitor event keys and docs/monitoring.md must agree.
+
+Every event the monitor emits through `monitor/sinks.py` is consumed
+by log readers that gate on the documented schema; a key added in code
+but not in `docs/monitoring.md` is invisible contract drift, and a
+documented key no code emits is a lie. The source of truth on the doc
+side is the machine-readable event-schema table between the
+
+    <!-- ds-lint:event-schema:begin --> / <!-- ds-lint:event-schema:end -->
+
+markers in docs/monitoring.md: one row per kind, keys backticked.
+
+On the code side the rule statically collects emissions: dict literals
+carrying `"kind"`, `base_event("<kind>", ...)` followed by
+`ev[...] =` / `ev.update(...)` mutations (kwargs, dict literals, and
+one-level resolution of helper-method dict returns), and
+`*.event("<kind>", key=...)` / `self._emit("<kind>", d)` calls. A
+kind whose key set involves an unresolvable expression is marked
+OPAQUE: the emitted-but-undocumented direction still applies to its
+statically-known keys, but documented keys are not reported dead
+(static analysis cannot prove their absence).
+
+The base envelope (`v`, `ts`, `kind`, `step`) is implicit.
+"""
+
+import ast
+import os
+
+from deepspeed_tpu.analysis import core
+
+RULE = "EVTSCHEMA"
+SUMMARY = ("monitor event keys must match the event-schema table in "
+           "docs/monitoring.md, bidirectionally")
+EXPLAIN = __doc__
+
+_EMIT_FUNCS = {"_emit", "_emit_kind", "event"}
+
+
+class _Event:
+    def __init__(self, kind, keys, mod, lineno, opaque=False):
+        self.kind = kind
+        self.keys = set(keys)
+        self.mod = mod
+        self.lineno = lineno
+        self.opaque = opaque
+
+
+def check(ctx):
+    reg = ctx.registry
+    findings = []
+    emitter_mods = [m for name, m in ctx.index.modules.items()
+                    if name.startswith(reg.EVENT_EMITTER_MODULE_PREFIXES)]
+    returns = _fixpoint_returns(ctx, emitter_mods)
+    events = []
+    for mod in emitter_mods:
+        for fi in mod.functions.values():
+            events.extend(_collect(ctx, fi, mod, returns))
+
+    doc_path = os.path.join(ctx.repo_root, reg.EVENT_SCHEMA_DOC)
+    doc_kinds, doc_lines, marker_line = _parse_doc(doc_path, reg)
+    if doc_kinds is None:
+        findings.append(core.Finding(
+            RULE, doc_path, 1, "",
+            "event-schema table markers not found in "
+            f"{reg.EVENT_SCHEMA_DOC} — add the ds-lint:event-schema "
+            "block (see docs/static-analysis.md)"))
+        return findings
+
+    by_kind = {}
+    for ev in events:
+        cur = by_kind.setdefault(ev.kind, _Event(ev.kind, (), ev.mod,
+                                                 ev.lineno))
+        cur.keys |= ev.keys
+        cur.opaque = cur.opaque or ev.opaque
+
+    base = set(reg.EVENT_BASE_KEYS)
+    for kind, ev in sorted(by_kind.items()):
+        if kind not in doc_kinds:
+            findings.append(core.Finding(
+                RULE, ev.mod.path, ev.lineno,
+                core.enclosing_qualname(ev.mod, ev.lineno),
+                f"event kind {kind!r} is emitted but has no row in "
+                f"the {reg.EVENT_SCHEMA_DOC} event-schema table"))
+            continue
+        undocumented = ev.keys - doc_kinds[kind] - base
+        for key in sorted(undocumented):
+            findings.append(core.Finding(
+                RULE, ev.mod.path, ev.lineno,
+                core.enclosing_qualname(ev.mod, ev.lineno),
+                f"event kind {kind!r} emits key {key!r} that is not "
+                f"in the {reg.EVENT_SCHEMA_DOC} event-schema table"))
+        if not ev.opaque:
+            dead = doc_kinds[kind] - ev.keys - base
+            for key in sorted(dead):
+                findings.append(core.Finding(
+                    RULE, doc_path, doc_lines[kind], "",
+                    f"event-schema table documents key {key!r} for "
+                    f"kind {kind!r} but no code emits it"))
+    for kind in sorted(set(doc_kinds) - set(by_kind)):
+        findings.append(core.Finding(
+            RULE, doc_path, doc_lines[kind], "",
+            f"event-schema table documents kind {kind!r} but no code "
+            "emits it"))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# doc side
+# ----------------------------------------------------------------------
+def _parse_doc(path, reg):
+    if not os.path.exists(path):
+        return None, None, None
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    try:
+        lo = next(i for i, t in enumerate(lines)
+                  if reg.EVENT_SCHEMA_BEGIN in t)
+        hi = next(i for i, t in enumerate(lines)
+                  if reg.EVENT_SCHEMA_END in t)
+    except StopIteration:
+        return None, None, None
+    kinds, kind_lines = {}, {}
+    for i in range(lo + 1, hi):
+        t = lines[i].strip()
+        if not t.startswith("|") or t.startswith("|---"):
+            continue
+        cells = [c.strip() for c in t.strip("|").split("|")]
+        if len(cells) < 2 or cells[0] in ("kind", ""):
+            continue
+        kind = cells[0].strip("`")
+        keys = set(_backticked(cells[1]))
+        kinds[kind] = keys
+        kind_lines[kind] = i + 1
+    return kinds, kind_lines, lo + 1
+
+
+def _backticked(text):
+    out, i = [], 0
+    while True:
+        a = text.find("`", i)
+        if a < 0:
+            return out
+        b = text.find("`", a + 1)
+        if b < 0:
+            return out
+        tok = text[a + 1:b].strip()
+        if tok:
+            out.append(tok)
+        i = b + 1
+
+
+# ----------------------------------------------------------------------
+# code side
+# ----------------------------------------------------------------------
+def _fixpoint_returns(ctx, mods):
+    """function key -> (keys, opaque) for functions returning
+    dict-shaped values, iterated to a fixpoint so helper chains
+    (_emit_memory_event -> _reconcile_memory -> ledger.reconcile)
+    resolve."""
+    returns = {}
+    for _ in range(4):
+        changed = False
+        for mod in mods:
+            for fi in mod.functions.values():
+                got = _returned_keys(ctx, fi, mod, returns)
+                if got is not None and returns.get(fi.key) != got:
+                    returns[fi.key] = got
+                    changed = True
+        if not changed:
+            break
+    return returns
+
+
+def _own_stmts(fn):
+    out = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            out.append(child)
+            walk(child)
+
+    walk(fn.node)
+    return out
+
+
+def _run_env(ctx, fn, mod, returns):
+    """Track dict-shaped locals through fn's own body.
+    env: name -> [kind|None, set(keys), opaque]."""
+    env = {}
+    emitted = []
+    attr_types = getattr(ctx.registry, "ATTR_TYPES", {})
+
+    def value_keys(expr):
+        """(kind, keys, opaque) for an expression, or None."""
+        if isinstance(expr, ast.Dict):
+            keys, kind, opaque = set(), None, False
+            for k, v in zip(expr.keys, expr.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    if k.value == "kind" and \
+                            isinstance(v, ast.Constant):
+                        kind = v.value
+                    else:
+                        keys.add(k.value)
+                elif k is None and isinstance(v, ast.Name) and \
+                        v.id in env:
+                    keys |= env[v.id][1]
+                    opaque = opaque or env[v.id][2]
+                else:
+                    opaque = True
+            return kind, keys, opaque
+        if isinstance(expr, ast.Call):
+            fname = expr.func.attr if isinstance(
+                expr.func, ast.Attribute) else (
+                expr.func.id if isinstance(expr.func, ast.Name)
+                else None)
+            if fname == "base_event" and expr.args:
+                k = expr.args[0]
+                kind = k.value if isinstance(k, ast.Constant) else None
+                return kind, set(), kind is None
+            if fname == "dict":
+                keys, opaque, kind = set(), False, None
+                for a in expr.args:
+                    sub = value_keys(a)
+                    if sub is None and isinstance(a, ast.Name) and \
+                            a.id in env:
+                        kind0, ks, op = env[a.id]
+                        kind = kind or kind0
+                        keys |= ks
+                        opaque = opaque or op
+                    elif sub is not None:
+                        kind = kind or sub[0]
+                        keys |= sub[1]
+                        opaque = opaque or sub[2]
+                    else:
+                        opaque = True
+                keys |= {kw.arg for kw in expr.keywords if kw.arg}
+                return kind, keys, opaque
+            # helper call returning a dict
+            tgt = ctx.index._resolve_one(expr, fn, mod, attr_types)
+            if tgt is not None and tgt.key in returns:
+                keys, opaque = returns[tgt.key]
+                return None, set(keys), opaque
+            return None, set(), True
+        if isinstance(expr, ast.Name) and expr.id in env:
+            kind, keys, opaque = env[expr.id]
+            return kind, set(keys), opaque
+        return None
+
+    for node in _own_stmts(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                got = value_keys(node.value)
+                if got is not None:
+                    kind, keys, opaque = got
+                    env[tgt.id] = [kind, keys, opaque]
+                    if kind is not None and \
+                            isinstance(node.value, ast.Dict):
+                        # inline event dict: emitted as-is
+                        emitted.append(_Event(kind, keys, mod,
+                                              node.lineno, opaque))
+                elif tgt.id in env:
+                    del env[tgt.id]
+            elif isinstance(tgt, ast.Subscript) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id in env:
+                sl = tgt.slice
+                if isinstance(sl, ast.Constant) and \
+                        isinstance(sl.value, str):
+                    env[tgt.value.id][1].add(sl.value)
+                else:
+                    env[tgt.value.id][2] = True
+        elif isinstance(node, ast.Call):
+            fname = node.func.attr if isinstance(
+                node.func, ast.Attribute) else (
+                node.func.id if isinstance(node.func, ast.Name)
+                else None)
+            if fname == "update" and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in env:
+                entry = env[node.func.value.id]
+                entry[1] |= {kw.arg for kw in node.keywords if kw.arg}
+                if any(kw.arg is None for kw in node.keywords):
+                    entry[2] = True
+                for a in node.args:
+                    got = value_keys(a)
+                    if got is None:
+                        entry[2] = True
+                    else:
+                        entry[1] |= got[1]
+                        entry[2] = entry[2] or got[2]
+            elif fname in _EMIT_FUNCS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                kind = node.args[0].value
+                keys, opaque = set(), False
+                keys |= {kw.arg for kw in node.keywords if kw.arg}
+                if any(kw.arg is None for kw in node.keywords):
+                    opaque = True
+                for a in node.args[1:]:
+                    got = value_keys(a)
+                    if got is None:
+                        opaque = True
+                    else:
+                        keys |= got[1]
+                        opaque = opaque or got[2]
+                emitted.append(_Event(kind, keys, mod, node.lineno,
+                                      opaque))
+        elif isinstance(node, ast.Dict):
+            # dict literal used inline (e.g. self.record({...}))
+            got = value_keys(node)
+            if got and got[0] is not None:
+                emitted.append(_Event(got[0], got[1], mod,
+                                      node.lineno, got[2]))
+
+    # base_event-created locals are emitted once fully built
+    for name, (kind, keys, opaque) in env.items():
+        if kind is not None:
+            emitted.append(_Event(kind, keys, mod, fn.node.lineno,
+                                  opaque))
+    return env, emitted
+
+
+def _returned_keys(ctx, fn, mod, returns):
+    env, _ = _run_env(ctx, fn, mod, returns)
+    keys, opaque, found = set(), False, False
+    for node in _own_stmts(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Constant) and v.value is None:
+            continue
+        if isinstance(v, ast.Name) and v.id in env:
+            found = True
+            keys |= env[v.id][1]
+            opaque = opaque or env[v.id][2]
+        elif isinstance(v, ast.Dict):
+            found = True
+            for k in v.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    keys.add(k.value)
+                else:
+                    opaque = True
+        else:
+            # returns something non-dict-literal: opaque as a dict
+            # source but only matters if a caller treats it as one
+            found = True
+            opaque = True
+    if not found:
+        return None
+    return (frozenset(keys), opaque)
+
+
+def _collect(ctx, fn, mod, returns):
+    _env, emitted = _run_env(ctx, fn, mod, returns)
+    # deduplicate inline-dict double counting (Assign handler + Dict
+    # handler can both see the same literal)
+    seen, out = set(), []
+    for ev in emitted:
+        sig = (ev.kind, ev.lineno, tuple(sorted(ev.keys)))
+        if sig in seen:
+            continue
+        seen.add(sig)
+        out.append(ev)
+    return out

@@ -1,0 +1,199 @@
+"""HOTSYNC — no host<->device sync reachable from a hot entrypoint.
+
+The static twin of the PR-2/5/7/9 runtime guard tests: those
+monkeypatch `jax.device_get`/`jax.effects_barrier` and count calls
+over a live training window; this rule walks the call graph from the
+declared hot entrypoints (registry.HOT_ENTRYPOINTS) and flags any
+sync call outside the declared fence sites (registry.FENCE_SITES) —
+at lint time, before a TPU ever runs.
+
+A "sync" is: `jax.device_get`, `block_until_ready`,
+`jax.effects_barrier`, `process_allgather`, `.item()`, or a host
+conversion (`float`/`int`/`bool`/`np.asarray`/`np.array`) applied to a
+value the local dataflow marks device-resident — assigned from a
+`*_jit` call, a `jnp.`/`lax.` call, or read off `self.state`.
+
+Functions defined INSIDE a hot entrypoint (the jitted step builders'
+inner functions) are hot too: a sync there fires at trace time.
+
+Registry integrity is part of the rule: a HOT_ENTRYPOINTS or
+FENCE_SITES entry that no longer resolves is itself a finding — a
+stale allowlist must not silently shrink coverage.
+"""
+
+import ast
+
+from deepspeed_tpu.analysis import core
+
+RULE = "HOTSYNC"
+SUMMARY = ("no device_get/block_until_ready/.item()/host-conversion "
+           "reachable from a hot entrypoint outside declared fences")
+EXPLAIN = __doc__
+
+_STATIC_NP_ATTRS = {"ndim", "shape", "size", "dtype"}
+
+
+def check(ctx):
+    reg = ctx.registry
+    findings = []
+    order, missing = ctx.index.reachable(
+        reg.HOT_ENTRYPOINTS, stop_keys=reg.FENCE_SITES,
+        attr_types=reg.ATTR_TYPES)
+    for key in missing:
+        mod_name = key.partition(":")[0]
+        mod = ctx.index.modules.get(mod_name)
+        findings.append(core.Finding(
+            RULE, mod.path if mod else mod_name, 1, "",
+            f"registry hot entrypoint {key!r} does not resolve — "
+            "update analysis/registry.py"))
+    for key in reg.FENCE_SITES:
+        if ctx.index.function(key) is None:
+            mod_name = key.partition(":")[0]
+            mod = ctx.index.modules.get(mod_name)
+            findings.append(core.Finding(
+                RULE, mod.path if mod else mod_name, 1, "",
+                f"registry fence site {key!r} does not resolve — "
+                "update analysis/registry.py"))
+
+    hot = {fi.key: fi for fi in order}
+    # inner functions of hot functions are hot (trace-time syncs)
+    for fi in list(hot.values()):
+        mod = ctx.index.modules[fi.module]
+        prefix = fi.qualname + f".{core.LOCALS_MARK}."
+        for q, inner in mod.functions.items():
+            if q.startswith(prefix):
+                hot.setdefault(inner.key, inner)
+
+    fence = set(reg.FENCE_SITES)
+    for fi in hot.values():
+        if core._matches_any(fi, fence):
+            continue
+        mod = ctx.index.modules[fi.module]
+        findings.extend(_scan_function(fi, mod, reg))
+    return findings
+
+
+def _scan_function(fn, mod, reg):
+    # the registry sets ARE the sync surface: the cross-check tests
+    # assert against them, so the rule must read them, not shadow them
+    sync_names = set(reg.SYNC_CALL_NAMES)
+    conversions = set(reg.HOST_CONVERSIONS)
+    np_conversions = set(reg.NP_CONVERSIONS)
+    out = []
+    devicey = _devicey_names(fn)
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "item" and name in sync_names:
+            # `.item()` specifically: the no-arg array method (an
+            # `items()`/`item(key)` call is something else)
+            if not node.args and not node.keywords and \
+                    isinstance(node.func, ast.Attribute):
+                out.append(_finding(
+                    fn, mod, node,
+                    "`.item()` host sync on the hot path"))
+        elif name in sync_names:
+            out.append(_finding(fn, mod, node,
+                                f"`{name}` call on the hot path"))
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in conversions and \
+                len(node.args) == 1 and \
+                _expr_devicey(node.args[0], devicey):
+            out.append(_finding(
+                fn, mod, node,
+                f"`{node.func.id}()` on a device value forces a "
+                "host transfer on the hot path"))
+        elif name in np_conversions and \
+                _attr_root(node.func) == "np" and node.args and \
+                _expr_devicey(node.args[0], devicey):
+            out.append(_finding(
+                fn, mod, node,
+                f"`np.{name}()` on a device value forces a host "
+                "transfer on the hot path"))
+    return out
+
+
+def _finding(fn, mod, node, msg):
+    return core.Finding(RULE, mod.path, node.lineno, fn.qualname,
+                        msg + f" (reachable from a hot entrypoint; "
+                        "move it behind a declared fence site or "
+                        "annotate with `# ds-lint: allow[HOTSYNC] "
+                        "<reason>`)", getattr(node, "col_offset", 0))
+
+
+def _own_nodes(fn):
+    """All AST nodes of fn excluding nested function bodies."""
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(fn.node)
+
+
+def _call_name(call):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _attr_root(node):
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _devicey_call(call):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr.endswith("_jit"):
+            return True
+        root = _attr_root(f)
+        if root in ("jnp", "lax") and \
+                f.attr not in _STATIC_NP_ATTRS:
+            return True
+    return False
+
+
+def _devicey_names(fn):
+    """Names assigned (in fn's own body) from device-producing calls:
+    `*_jit(...)`, `jnp.`/`lax.` calls — including tuple unpacks."""
+    names = set()
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            if _devicey_call(node.value):
+                for tgt in node.targets:
+                    names.update(_target_names(tgt))
+    return names
+
+
+def _target_names(tgt):
+    if isinstance(tgt, ast.Name):
+        return {tgt.id}
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out = set()
+        for el in tgt.elts:
+            out |= _target_names(el)
+        return out
+    return set()
+
+
+def _expr_devicey(expr, devicey_names):
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Name) and node.id in devicey_names:
+            return True
+        if isinstance(node, ast.Call) and _devicey_call(node):
+            return True
+        if isinstance(node, ast.Attribute):
+            parts = core._attr_parts(node)
+            if parts and parts[0] == "self" and "state" in parts[1:]:
+                return True
+    return False

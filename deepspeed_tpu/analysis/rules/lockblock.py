@@ -1,0 +1,115 @@
+"""LOCKBLOCK — no blocking filesystem/queue work while holding a lock.
+
+The monitor sinks, the flight recorder, and the async checkpoint
+writer all share one discipline: a `threading.Lock` protects in-memory
+state only; durability work (`fsync`, `os.replace`/`rename`,
+`rmtree`), sleeps, and blocking queue ops happen OUTSIDE the critical
+section (flight dumps snapshot under the lock, then write unlocked).
+An fsync under a lock the hot path also takes turns a slow filesystem
+into a training stall — the exact coupling the monitor exists to
+observe, not cause.
+
+The rule flags, inside any `with <something named *lock*>:` body:
+  * `fsync` / `replace` / `rename` / `rmtree` / `sleep` calls;
+  * `.put(...)` / `.get(...)` on a queue-shaped receiver (name
+    contains "queue" or ends in `_q`) without a `block=False` /
+    `timeout=` escape or a `_nowait` variant.
+
+Deliberate exceptions (e.g. the JSONL sink's close-time fsync, which
+must order against concurrent writers) carry
+`# ds-lint: allow[LOCKBLOCK] <reason>`.
+"""
+
+import ast
+import re
+
+from deepspeed_tpu.analysis import core
+
+RULE = "LOCKBLOCK"
+SUMMARY = ("no fsync/replace/rename/sleep or blocking queue ops while "
+           "holding a threading.Lock")
+EXPLAIN = __doc__
+
+_LOCK_NAME_RE = re.compile(r"lock", re.IGNORECASE)
+_QUEUE_NAME_RE = re.compile(r"(queue|_q$|^q$)", re.IGNORECASE)
+
+
+def check(ctx):
+    reg = ctx.registry
+    findings = []
+    for mod in ctx.index.modules.values():
+        for with_node in ast.walk(mod.tree):
+            if not isinstance(with_node, ast.With):
+                continue
+            if not any(_is_lock_ctx(item.context_expr)
+                       for item in with_node.items):
+                continue
+            for node in _body_nodes(with_node):
+                msg = _blocking_call(node, reg)
+                if msg:
+                    findings.append(core.Finding(
+                        RULE, mod.path, node.lineno,
+                        core.enclosing_qualname(mod, node.lineno),
+                        msg + " while holding a lock — move it "
+                        "outside the critical section or annotate "
+                        "`# ds-lint: allow[LOCKBLOCK] <reason>`",
+                        node.col_offset))
+    return findings
+
+
+def _is_lock_ctx(expr):
+    name = None
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return _is_lock_ctx(expr.func)
+    return bool(name and _LOCK_NAME_RE.search(name))
+
+
+def _body_nodes(with_node):
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from walk(child)
+
+    for stmt in with_node.body:
+        yield stmt
+        yield from walk(stmt)
+
+
+def _blocking_call(node, reg):
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    fname = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if fname in reg.BLOCKING_CALL_NAMES:
+        # `.replace()` on a string is not os.replace: require the
+        # stdlib module receiver (os/shutil/time) or a bare
+        # from-imported name
+        if isinstance(f, ast.Attribute):
+            root = f.value
+            root_name = root.id if isinstance(root, ast.Name) else None
+            if root_name in ("os", "shutil", "time"):
+                return f"blocking `{root_name}.{fname}` call"
+            return None
+        return f"blocking `{fname}` call"
+    if fname in reg.QUEUE_CALL_NAMES and isinstance(f, ast.Attribute):
+        recv = f.value
+        recv_name = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else "")
+        if not _QUEUE_NAME_RE.search(recv_name or ""):
+            return None
+        for kw in node.keywords:
+            if kw.arg == "timeout":
+                return None
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return None
+        return f"blocking queue `.{fname}()`"
+    return None

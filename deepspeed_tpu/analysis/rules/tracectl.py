@@ -1,0 +1,152 @@
+"""TRACECTL — no Python control flow on traced array values.
+
+Inside a jit-traced function, `if`/`while`/`assert` on a traced array
+raises `TracerBoolConversionError` at best and silently specializes
+the trace at worst. The correct forms are `lax.cond` / `lax.select` /
+`jnp.where` (the repo's overflow vote and stage-3 scheduler use them
+throughout).
+
+A function is traced when it is (a) passed by name to `jax.jit` /
+`shard_map` / `lax.scan` / `lax.cond` / `lax.while_loop` /
+`pallas_call` / `custom_vjp`'s `defvjp` etc., (b) decorated with one
+of those, or (c) statically called from a traced function. The rule
+flags `if`/`while`/`assert` whose test contains a `jnp.`/`lax.` call
+(shape/dtype introspection like `jnp.ndim` is static and exempt).
+"""
+
+import ast
+
+from deepspeed_tpu.analysis import core
+from deepspeed_tpu.analysis.rules.hotsync import (_attr_root, _own_nodes)
+
+RULE = "TRACECTL"
+SUMMARY = ("no Python if/while/assert on traced array values inside "
+           "jit-traced functions")
+EXPLAIN = __doc__
+
+_STATIC_ATTRS = {"ndim", "shape", "size", "dtype", "issubdtype",
+                 "result_type", "iinfo", "finfo"}
+
+
+def check(ctx):
+    reg = ctx.registry
+    traced = _traced_seed(ctx)
+    # closure over static calls
+    work = list(traced.values())
+    while work:
+        fi = work.pop()
+        for _c, tgt in ctx.index.resolve_calls(fi, reg.ATTR_TYPES):
+            if tgt is not None and tgt.key not in traced:
+                traced[tgt.key] = tgt
+                work.append(tgt)
+
+    findings = []
+    for fi in traced.values():
+        mod = ctx.index.modules[fi.module]
+        for node in _own_nodes(fi):
+            test = None
+            kind = None
+            if isinstance(node, (ast.If, ast.While)):
+                test, kind = node.test, type(node).__name__.lower()
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            if test is None or not _test_on_traced_value(test):
+                continue
+            findings.append(core.Finding(
+                RULE, mod.path, node.lineno, fi.qualname,
+                f"Python `{kind}` on a traced array value inside a "
+                "jit-traced function — use lax.cond/lax.select/"
+                "jnp.where (or hoist the check out of the trace)",
+                getattr(node, "col_offset", 0)))
+    return findings
+
+
+def _traced_seed(ctx):
+    """Functions directly handed to a tracing entrypoint."""
+    reg = ctx.registry
+    traced = {}
+    for mod in ctx.index.modules.values():
+        # decorated defs
+        for fi in mod.functions.values():
+            for dec in fi.node.decorator_list:
+                if _tracing_name(dec, reg):
+                    traced[fi.key] = fi
+        # functions passed by name (inside other functions)
+        for fi in mod.functions.values():
+            for node in _own_nodes(fi):
+                self_seed = _seed_from_call(node, reg, lambda n:
+                                            _resolve_local(ctx, fi,
+                                                           mod, n))
+                for tgt in self_seed:
+                    traced[tgt.key] = tgt
+        # functions passed by name at module level
+        # (`step_jit = jax.jit(step)` outside any def)
+        for node in _module_level_nodes(mod):
+            for tgt in _seed_from_call(node, reg, mod.functions.get):
+                traced[tgt.key] = tgt
+    return traced
+
+
+def _module_level_nodes(mod):
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(mod.tree)
+
+
+def _seed_from_call(node, reg, resolve):
+    if not isinstance(node, ast.Call):
+        return []
+    name = node.func.attr if isinstance(node.func, ast.Attribute) \
+        else (node.func.id if isinstance(node.func, ast.Name)
+              else None)
+    if name not in reg.TRACING_ENTRY_CALLS and name != "defvjp":
+        return []
+    out = []
+    for arg in node.args:
+        if isinstance(arg, ast.Name):
+            tgt = resolve(arg.id)
+            if tgt is not None:
+                out.append(tgt)
+    return out
+
+
+def _tracing_name(dec, reg):
+    node = dec
+    if isinstance(node, ast.Call):
+        # @partial(jax.jit, ...) / @jax.custom_vjp(...)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                n = sub.attr if isinstance(sub, ast.Attribute) else sub.id
+                if n in reg.TRACING_ENTRY_CALLS:
+                    return True
+        return False
+    if isinstance(node, ast.Attribute):
+        return node.attr in reg.TRACING_ENTRY_CALLS
+    if isinstance(node, ast.Name):
+        return node.id in reg.TRACING_ENTRY_CALLS
+    return False
+
+
+def _resolve_local(ctx, fn, mod, name):
+    prefix = fn.qualname + f".{core.LOCALS_MARK}."
+    return (mod.functions.get(prefix + name) or
+            mod.functions.get(name) or
+            (mod.functions.get(f"{fn.class_name}.{name}")
+             if fn.class_name else None))
+
+
+def _test_on_traced_value(test):
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute):
+            if node.func.attr in _STATIC_ATTRS:
+                continue
+            if _attr_root(node.func) in ("jnp", "lax"):
+                return True
+    return False

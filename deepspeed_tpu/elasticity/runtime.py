@@ -503,6 +503,10 @@ class ElasticSupervisor:
                 compute_bytes=np.dtype(engine.compute_dtype).itemsize,
                 sr_mode=engine.bf16_sr_mode, gas=engine._jit_gas())
         except Exception:
+            # the plan is forensic garnish on the recovery event, not
+            # required for the recovery itself — but say why it's gone
+            logger.warning("ZeRO memory-plan computation failed",
+                           exc_info=True)
             self.zero_plan = None
         return engine
 
@@ -519,8 +523,8 @@ class ElasticSupervisor:
             engine.shutdown(
                 wait_for_checkpoint=drain,
                 checkpoint_timeout=self.rt.drain_timeout_sec)
-        except Exception as e:
-            logger.warning(f"engine teardown raised: {e}")
+        except Exception:
+            logger.warning("engine teardown raised", exc_info=True)
         finally:
             self._carried_abandoned = [
                 w for w in getattr(engine, "_abandoned_ckpt_writers",
@@ -534,11 +538,12 @@ class ElasticSupervisor:
         tag = f"global_step{self._step}"
         try:
             self.engine.save_checkpoint(self.save_dir, tag=tag)
-        except Exception as e:
+        except Exception:
             # a failed save must not kill the run — the next boundary
             # retries with a fresh tag; recovery uses the last
             # COMMITTED one either way
-            logger.warning(f"checkpoint save '{tag}' failed: {e}")
+            logger.warning(f"checkpoint save '{tag}' failed",
+                           exc_info=True)
 
     def _load_latest(self):
         """Newest committed tag -> engine (resharded restore under the
@@ -572,7 +577,11 @@ class ElasticSupervisor:
                     eng.monitor.flight.set_context(
                         last_recovery=dict(event))
             except Exception:
-                pass
+                # supervisor telemetry must not abort a recovery in
+                # progress, but a silently-broken event stream would
+                # blind every later post-mortem
+                logger.warning("recovery event emission failed",
+                               exc_info=True)
 
     def _recover(self, cause, lost_hosts=(), error=None):
         detect_t = time.monotonic()
@@ -736,7 +745,7 @@ class ElasticSupervisor:
                     self.engine.train_batch(batch=batch)))
             except LossContinuityError:
                 raise
-            except Exception as e:
+            except Exception as e:  # ds-lint: allow[BROADEXC] failure is routed into _recover (cause+error land on the recovery event)
                 # input-pipeline failures recover exactly like engine
                 # failures — batch_fn is part of the supervised loop
                 self._recover(cause=ENGINE_ERROR, error=e)

@@ -64,6 +64,7 @@ from deepspeed_tpu.monitor.trace import (SPAN_BACKWARD, SPAN_CKPT,
 from deepspeed_tpu.monitor.trace_export import (CAT_SUBSYSTEM,
                                                 TraceExporter)
 from deepspeed_tpu.monitor.watchdog import StallWatchdog
+from deepspeed_tpu.utils.logging import logger
 
 __all__ = [
     "Monitor", "MetricsRegistry", "StepTrace", "StallWatchdog",
@@ -96,6 +97,7 @@ class Monitor:
         self.registry = MetricsRegistry()
         self.trace = StepTrace()
         self.sinks = []
+        self._sink_emit_warned = set()
         self.watchdog = None
         self.trace_export = None
         self.flight = None
@@ -513,7 +515,17 @@ class Monitor:
             try:
                 sink.emit(event)
             except Exception:
-                pass
+                # telemetry must never kill training, but a sink that
+                # silently drops every event blinds the run — warn
+                # once per sink, with the traceback (duck-typed user
+                # sinks may lack .name)
+                name = getattr(sink, "name", type(sink).__name__)
+                if name not in self._sink_emit_warned:
+                    self._sink_emit_warned.add(name)
+                    logger.warning(
+                        f"monitor sink {name!r} emit failed "
+                        "(suppressing further warnings for this sink)",
+                        exc_info=True)
 
     def _emit_kind(self, kind, fields):
         """Thread-safe host-event hook (checkpoint writer, watchdog)."""
@@ -541,7 +553,8 @@ class Monitor:
                 try:
                     self.flight.dump(kind, extra=fields)
                 except Exception:
-                    pass
+                    logger.warning(f"flight dump on {kind!r} failed",
+                                   exc_info=True)
             self._export_trace_safe()
 
     def event(self, kind, **fields):
@@ -566,7 +579,7 @@ class Monitor:
                 # because a post-mortem must never raise
                 payload = self._reconcile_memory(
                     self._flight_step() or 0)
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] an OOM post-mortem must never raise while handling the original failure
                 payload = self._last_memory or \
                     self.ledger.reconcile(None, None)
             extra["oom"] = {
@@ -580,7 +593,7 @@ class Monitor:
             try:
                 self.flight.record_exception(exc)
                 self.flight.dump(reason, extra=extra)
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] crash forensics must not mask the original exception mid-propagation
                 pass
         self._export_trace_safe()
 
@@ -613,7 +626,9 @@ class Monitor:
         try:
             self.export_trace()
         except Exception:
-            pass
+            # trace export rides failure paths (stall, crash, close);
+            # it must not raise there — but leave the evidence
+            logger.warning("trace export failed", exc_info=True)
 
     def _maybe_flush(self):
         now = time.monotonic()
@@ -622,7 +637,7 @@ class Monitor:
             for sink in self.sinks:
                 try:
                     sink.flush()
-                except Exception:
+                except Exception:  # ds-lint: allow[BROADEXC] flush is advisory visibility; real sink failures surface at emit (warn-once)
                     pass
 
     # ------------------------------------------------------------------
@@ -701,5 +716,8 @@ class Monitor:
                 sink.flush()
                 sink.close()
             except Exception:
-                pass
+                logger.warning(
+                    f"monitor sink "
+                    f"{getattr(sink, 'name', type(sink).__name__)!r} "
+                    "close failed", exc_info=True)
         self.sinks = []

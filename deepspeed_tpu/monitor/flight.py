@@ -52,7 +52,7 @@ def _dump_all(reason):
     for rec in list(_LIVE):
         try:
             rec.dump(reason)
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] a post-mortem dump must never raise out of a signal handler
             pass
 
 
@@ -76,7 +76,7 @@ def _on_atexit():
         try:
             if rec.armed and os.path.isdir(rec.out_dir):
                 rec.dump("atexit")
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] atexit hooks must not raise during interpreter teardown
             pass
 
 
@@ -116,7 +116,8 @@ class FlightRecorder:
             # dir (ephemeral run dirs deleted before exit are left
             # alone), so the dir must exist from the start
             os.makedirs(out_dir, exist_ok=True)
-        except Exception:
+        except OSError:
+            # unwritable dir: dump() retries and logs at dump time
             pass
         self._ring = collections.deque(maxlen=self.capacity)
         self._lock = threading.Lock()
@@ -161,18 +162,18 @@ class FlightRecorder:
         if self._heartbeats_fn is not None:
             try:
                 heartbeats, terminal = self._heartbeats_fn()
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] a broken context callback must not kill the dump that documents the crash
                 pass
         if self._context_fn is not None:
             try:
                 context.update(self._context_fn() or {})
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] a broken context callback must not kill the dump that documents the crash
                 pass
         step = None
         if self._step_fn is not None:
             try:
                 step = self._step_fn()
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] a broken context callback must not kill the dump that documents the crash
                 pass
         doc = {
             "v": FLIGHT_SCHEMA_VERSION,
@@ -209,10 +210,11 @@ class FlightRecorder:
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
-        except Exception as e:
+        except Exception:
             try:
-                logger.warning(f"flight recorder dump failed: {e}")
-            except Exception:
+                logger.warning("flight recorder dump failed",
+                               exc_info=True)
+            except Exception:  # ds-lint: allow[BROADEXC] logging during interpreter teardown may itself fail; the dump path must not raise
                 pass
             return None
         self._dumps.append(path)
@@ -220,7 +222,7 @@ class FlightRecorder:
             logger.warning(
                 f"flight recorder: dumped last {len(doc['events'])} "
                 f"events to {path} (reason: {reason})")
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] logging during interpreter teardown may itself fail; the dump path must not raise
             pass
         return path
 
@@ -228,7 +230,7 @@ class FlightRecorder:
 def _json_default(x):
     try:
         return float(x)
-    except Exception:
+    except (TypeError, ValueError):
         return str(x)
 
 

@@ -87,7 +87,8 @@ def host_rss_bytes():
         with open("/proc/self/statm") as f:
             rss_pages = int(f.read().split()[1])
         return rss_pages * os.sysconf("SC_PAGE_SIZE")
-    except Exception:
+    except (OSError, ValueError, IndexError):
+        # no /proc (non-Linux) or malformed statm: gauge degrades
         return None
 
 
@@ -108,7 +109,7 @@ def leaf_nbytes(leaf, per_device=True):
         if sharding is not None:
             try:
                 shape = sharding.shard_shape(tuple(shape))
-            except Exception:
+            except Exception:  # ds-lint: allow[BROADEXC] exotic shardings without shard_shape fall back to full-size accounting
                 pass
     return int(np.prod(shape)) * np.dtype(dtype).itemsize
 
@@ -157,7 +158,7 @@ class MemoryLedger:
         """Register a pytree's bytes (sharding-aware, metadata only)."""
         try:
             nbytes = tree_nbytes(tree, per_device=per_device)
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] ledger registration over arbitrary client pytrees must never kill engine init
             nbytes = 0
         return self.register(category, name, nbytes, space=space,
                              meta=meta)
@@ -180,7 +181,7 @@ class MemoryLedger:
         paths run in finally blocks and must never raise."""
         try:
             key = (str(token[0]), str(token[1]))
-        except Exception:
+        except (TypeError, IndexError, KeyError):
             return
         with self._lock:
             self._entries.pop(key, None)
@@ -197,7 +198,7 @@ class MemoryLedger:
             if e["fn"] is not None:
                 try:
                     b = int(e["fn"]() or 0)
-                except Exception:
+                except Exception:  # ds-lint: allow[BROADEXC] dynamic gauges are client callables; telemetry must never kill training
                     b = 0
             out.append((e, b))
         return out
@@ -361,7 +362,7 @@ def classify_oom(exc):
         return True
     try:
         text = f"{type(exc).__name__}: {exc}".upper()
-    except Exception:
+    except Exception:  # ds-lint: allow[BROADEXC] classifying an exception whose __str__ itself raises; must not mask the original failure
         return False
     return any(m in text for m in _OOM_MARKERS) or \
         bool(_OOM_WORD.search(text))

@@ -72,6 +72,7 @@ class JsonlSink(Sink):
     def sync(self):
         with self._lock:
             try:
+                # ds-lint: allow[LOCKBLOCK] durability point (close/escalation only, never per-fence); the lock orders it against concurrent emit writers
                 os.fsync(self._fd)
             except OSError:
                 pass
@@ -90,7 +91,7 @@ def _json_default(x):
     # numpy / jax scalars that slip into an event
     try:
         return float(x)
-    except Exception:
+    except (TypeError, ValueError):
         return str(x)
 
 
@@ -164,8 +165,9 @@ def build_sinks(sink_specs, output_dir, job_name=""):
                     f"{list(VALID_SINKS)}")
         except ValueError:
             raise
-        except Exception as e:
-            logger.warning(f"monitor sink {name!r} unavailable: {e}")
+        except Exception:
+            logger.warning(f"monitor sink {name!r} unavailable",
+                           exc_info=True)
     return sinks
 
 

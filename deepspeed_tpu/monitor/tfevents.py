@@ -108,7 +108,7 @@ class TFEventsWriter:
         os.makedirs(log_dir, exist_ok=True)
         try:
             host = socket.gethostname()
-        except Exception:
+        except OSError:
             host = "localhost"
         self.path = os.path.join(
             log_dir,
@@ -144,6 +144,7 @@ class TFEventsWriter:
         with self._lock:
             if not self._f.closed:
                 self._f.flush()
+                # ds-lint: allow[LOCKBLOCK] one fsync at close only; the lock orders it against concurrent add_scalars writers
                 os.fsync(self._f.fileno())
                 self._f.close()
 

@@ -130,7 +130,7 @@ def main(argv=None):
         # `ds_trace summary | head` closing stdout is not an error
         try:
             sys.stdout.close()
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] closing an already-broken pipe; any error here is noise on exit
             pass
         return 0
 

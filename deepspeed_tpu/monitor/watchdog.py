@@ -129,8 +129,8 @@ class StallWatchdog:
                     f"{time.monotonic() - t0:.3f}s — the device queue is "
                     "drained; the stall is host-side (input pipeline, "
                     "checkpoint barrier, or the loop itself)")
-            except Exception as e:
-                logger.warning(f"stall probe failed: {e}")
+            except Exception:
+                logger.warning("stall probe failed", exc_info=True)
 
         threading.Thread(target=probe, name="ds-tpu-stall-probe",
                          daemon=True).start()
@@ -181,14 +181,18 @@ class StallWatchdog:
                 try:
                     self._emit("stall", diag)
                 except Exception:
-                    pass
+                    # a broken sink must not kill the watchdog thread,
+                    # but the evidence of WHY it broke must survive
+                    logger.warning("stall event emit failed",
+                                   exc_info=True)
             if self.probe:
                 self._probe_device()
             if self.on_stall is not None:
                 try:
                     self.on_stall(diag)
-                except Exception as e:
-                    logger.warning(f"stall callback raised: {e}")
+                except Exception:
+                    logger.warning("stall callback raised",
+                                   exc_info=True)
             if escalate:
                 ediag = dict(diag, escalate_after=self.escalate_after)
                 logger.error(
@@ -200,13 +204,15 @@ class StallWatchdog:
                     try:
                         self._emit("stall_escalated", ediag)
                     except Exception:
-                        pass
+                        logger.warning(
+                            "stall_escalated event emit failed",
+                            exc_info=True)
                 if self.on_escalate is not None:
                     try:
                         self.on_escalate(ediag)
-                    except Exception as e:
-                        logger.warning(
-                            f"escalation callback raised: {e}")
+                    except Exception:
+                        logger.warning("escalation callback raised",
+                                       exc_info=True)
 
     def stop(self):
         self._stop.set()

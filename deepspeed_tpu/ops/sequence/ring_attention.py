@@ -184,7 +184,7 @@ def _mesh_targets_tpu(mesh):
     silently chose the XLA fallback under cross-backend AOT."""
     try:
         return mesh.devices.flat[0].platform == "tpu"
-    except Exception:   # AbstractMesh or device-less mesh variants
+    except Exception:  # ds-lint: allow[BROADEXC] AbstractMesh / device-less mesh variants have no .devices; fall back to the host backend
         return jax.default_backend() == "tpu"
 
 

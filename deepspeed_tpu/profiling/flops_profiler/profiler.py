@@ -575,8 +575,9 @@ def get_model_profile(model=None,
                 compute_flops=True, compute_vjp_flops=detailed,
                 depth=None if module_depth == -1 else module_depth)(
                     *args, **kwargs)
-        except Exception as e:
-            logger.warning(f"nn.tabulate breakdown unavailable: {e}")
+        except Exception:
+            logger.warning("nn.tabulate breakdown unavailable",
+                           exc_info=True)
     assert args is not None
 
     prof = FlopsProfiler(model)

@@ -243,7 +243,8 @@ def _host_offload_supported():
             logger.warning(
                 f"cpu_checkpointing requested but the backend does not "
                 f"support pinned_host memory ({type(e).__name__}); "
-                "falling back to on-device checkpointing")
+                "falling back to on-device checkpointing",
+                exc_info=True)
             _host_offload_ok = False
     return _host_offload_ok
 

@@ -827,7 +827,14 @@ class AsyncCheckpointWriter:
                     try:
                         on_done()
                     except Exception:
-                        pass
+                        # the hook releases ledger entries etc.; its
+                        # failure must not kill the writer thread but
+                        # must leave evidence
+                        from deepspeed_tpu.utils.logging import logger
+                        import traceback
+                        logger.warning(
+                            "checkpoint on_done hook failed:\n"
+                            + traceback.format_exc())
 
         t = threading.Thread(target=run, daemon=False,
                              name=f"ckpt-writer-{tag}")

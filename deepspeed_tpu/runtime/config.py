@@ -120,7 +120,21 @@ def get_sparse_attention(param_dict):
     if C.SPARSE_ATTENTION in param_dict:
         sparsity = param_dict[C.SPARSE_ATTENTION]
         mode = get_scalar_param(sparsity, C.SPARSE_MODE, C.SPARSE_MODE_DEFAULT)
-        sparsity = dict(sparsity)
+        if mode not in C.SPARSE_MODE_VALID:
+            raise DeepSpeedConfigError(
+                f"sparse_attention.mode must be one of "
+                f"{list(C.SPARSE_MODE_VALID)}, got {mode!r}")
+        # the block passes through wholesale to the SparsityConfig
+        # constructors; an unknown key would otherwise surface as a
+        # TypeError deep inside ops/sparse_attention
+        unknown = set(sparsity) - set(C.SPARSE_ATTENTION_KEYS)
+        if unknown:
+            logger.warning(
+                f"sparse_attention: ignoring unknown key(s) "
+                f"{sorted(unknown)}; known keys: "
+                f"{list(C.SPARSE_ATTENTION_KEYS)}")
+        sparsity = {k: v for k, v in sparsity.items()
+                    if k in C.SPARSE_ATTENTION_KEYS}
         sparsity[C.SPARSE_MODE] = mode
         return sparsity
     return None
@@ -300,9 +314,20 @@ def get_pld_enabled(param_dict):
 
 def get_pld_params(param_dict):
     if C.PROGRESSIVE_LAYER_DROP in param_dict:
-        pld_params = dict(param_dict[C.PROGRESSIVE_LAYER_DROP])
-        pld_params.pop(C.PLD_ENABLED, None)
-        return pld_params
+        block = param_dict[C.PROGRESSIVE_LAYER_DROP]
+        # pass through ONLY the declared keys, and only when present:
+        # absent keys fall to ProgressiveLayerDrop's constructor
+        # defaults (theta=0.5) — substituting C.PLD_THETA_DEFAULT
+        # (1.0, the reference constants value) here would silently
+        # turn PLD into a no-op for configs that just set enabled
+        unknown = set(block) - {C.PLD_ENABLED, C.PLD_THETA,
+                                C.PLD_GAMMA}
+        if unknown:
+            logger.warning(
+                f"progressive_layer_drop: ignoring unknown key(s) "
+                f"{sorted(unknown)}")
+        return {k: block[k] for k in (C.PLD_THETA, C.PLD_GAMMA)
+                if k in block}
     return False
 
 
@@ -389,8 +414,8 @@ class DeepSpeedConfig:
 
         # Elasticity: env-provided config overrides the batch triple.
         self.elasticity_enabled = False
-        ec = self._param_dict.get("elasticity", None)
-        if ec is not None and ec.get("enabled", False):
+        ec = self._param_dict.get(C.ELASTICITY, None)
+        if ec is not None and ec.get(C.ELASTICITY_ENABLED, False):
             self._apply_elasticity(ec)
 
         self._initialize_params(self._param_dict)
@@ -402,7 +427,7 @@ class DeepSpeedConfig:
         try:
             import jax
             return jax.device_count()
-        except Exception:
+        except Exception:  # ds-lint: allow[BROADEXC] config parsing must work without an initialized backend; world size defaults to 1
             return 1
 
     def _apply_elasticity(self, ec):
@@ -609,7 +634,7 @@ class DeepSpeedConfig:
                 "compresses the ZeRO-Offload host link and has no effect "
                 "without cpu_offload: true")
         fp16_enabled = self.fp16_enabled or self.zero_enabled
-        vocabulary_size = self._param_dict.get("vocabulary_size", None)
+        vocabulary_size = self._param_dict.get(C.VOCABULARY_SIZE, None)
         if vocabulary_size and vocabulary_size % 8 != 0:
             logger.warning(
                 "DeepSpeedConfig: vocabulary size should be aligned to 8 for "

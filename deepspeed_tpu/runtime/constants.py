@@ -13,6 +13,7 @@ ROUTE_TRAIN = "train"
 ROUTE_EVAL = "eval"
 ROUTE_PREDICT = "predict"
 ROUTE_ENCODE = "encode"
+ROUTES = (ROUTE_TRAIN, ROUTE_EVAL, ROUTE_PREDICT, ROUTE_ENCODE)
 
 #############################################
 # Batch size
@@ -341,10 +342,46 @@ SPARSE_GLOBAL_BLOCK_END_INDICES_DEFAULT = None
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT = 3
 
+SPARSE_MODE_VALID = (
+    SPARSE_DENSE_MODE,
+    SPARSE_FIXED_MODE,
+    SPARSE_VARIABLE_MODE,
+    SPARSE_BIGBIRD_MODE,
+    SPARSE_BSLONGFORMER_MODE,
+)
+# the full sparse block surface: the block is passed through wholesale
+# to the SparsityConfig constructors (ops/sparse_attention), so config
+# parsing validates against this list instead of reading each key
+SPARSE_ATTENTION_KEYS = (
+    SPARSE_MODE,
+    SPARSE_BLOCK,
+    SPARSE_DIFFERENT_LAYOUT_PER_HEAD,
+    SPARSE_NUM_LOCAL_BLOCKS,
+    SPARSE_NUM_GLOBAL_BLOCKS,
+    SPARSE_ATTENTION_TYPE,
+    SPARSE_HORIZONTAL_GLOBAL_ATTENTION,
+    SPARSE_NUM_DIFFERENT_GLOBAL_PATTERNS,
+    SPARSE_NUM_RANDOM_BLOCKS,
+    SPARSE_LOCAL_WINDOW_BLOCKS,
+    SPARSE_GLOBAL_BLOCK_INDICES,
+    SPARSE_GLOBAL_BLOCK_END_INDICES,
+    SPARSE_NUM_SLIDING_WINDOW_BLOCKS,
+)
+
+#############################################
+# Elasticity (ref elasticity/constants.py) + model metadata
+#############################################
+ELASTICITY = "elasticity"
+ELASTICITY_ENABLED = "enabled"
+# model metadata consumed by the FLOPS profiler's MFU denominator
+VOCABULARY_SIZE = "vocabulary_size"
+
 #############################################
 # TPU-native extensions (no reference analogue)
 #############################################
 # Mesh block: {"mesh": {"data": -1, "model": 1, "pipe": 1}}. -1 = infer.
+# The axis-name constants are the canonical names runtime/mesh.py
+# builds the jax Mesh with.
 MESH = "mesh"
 MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"

@@ -553,12 +553,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         try:
             from deepspeed_tpu.monitor.tfevents import SummaryWriter
             return SummaryWriter(log_dir)
-        except Exception as e:
+        except Exception:
             if not DeepSpeedEngine._tb_fallback_warned:
                 DeepSpeedEngine._tb_fallback_warned = True
                 logger.warning(
-                    f"tensorboard unavailable ({e}); scalar summaries "
-                    "are disabled for this run")
+                    "tensorboard unavailable; scalar summaries are "
+                    "disabled for this run", exc_info=True)
             return None
 
     # ------------------------------------------------------------------
@@ -1528,6 +1528,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def deepspeed_io(self, dataset, batch_size=None, route=C.ROUTE_TRAIN,
                      pin_memory=None, data_sampler=None, collate_fn=None,
                      num_local_io_workers=None):
+        if route not in C.ROUTES:
+            raise ValueError(
+                f"deepspeed_io route must be one of {list(C.ROUTES)}, "
+                f"got {route!r}")
         if batch_size is None:
             # Each process loads its share of the *global* microbatch
             # (micro_bs is per-device; one controller may host many devices).
@@ -1750,6 +1754,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             # reference). This device_get serializes host and device
             # every step; async mode gets the same semantics for free
             # from the device-resident schedule.
+            # ds-lint: allow[HOTSYNC] legacy synced loop only: the deliberate per-step rendezvous async mode exists to delete
             if bool(jax.device_get(overflow)) and \
                     self.lr_scheduler is not None:
                 self.lr_scheduler.step(
@@ -1874,7 +1879,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     not getattr(e, "_ds_flight_dumped", False):
                 try:
                     e._ds_flight_dumped = True
-                except Exception:
+                except Exception:  # ds-lint: allow[BROADEXC] exotic exception classes may reject attribute marks; dedup is best-effort
                     pass
                 self.monitor.on_crash(e)
             raise
@@ -1937,8 +1942,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 # free of device_get syncs (count <= host steps always).
                 if not self._onebit_compressed_active and \
                         self._host_steps >= self._onebit_freeze_step and \
-                        int(jax.device_get(self.state.opt_state.count)) \
-                        >= self._onebit_freeze_step:
+                        int(jax.device_get(self.state.opt_state.count)) >= self._onebit_freeze_step:  # ds-lint: allow[HOTSYNC] host-step pre-check gates this fetch to at most one per run (the freeze_step phase switch)
                     self._onebit_compressed_active = True
                     log_dist(
                         "OnebitAdam: entering compressed phase "

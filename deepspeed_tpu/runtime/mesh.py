@@ -16,9 +16,14 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-PIPE_AXIS = "pipe"
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
+from deepspeed_tpu.runtime.constants import (MESH_DATA_AXIS,
+                                             MESH_MODEL_AXIS,
+                                             MESH_PIPE_AXIS)
+
+# axis names shared with the "mesh" config block keys
+PIPE_AXIS = MESH_PIPE_AXIS
+DATA_AXIS = MESH_DATA_AXIS
+MODEL_AXIS = MESH_MODEL_AXIS
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
 
 

@@ -113,20 +113,20 @@ class PrefetchLoader:
                         from deepspeed_tpu.monitor.memory import \
                             tree_nbytes
                         self.staged_nbytes = tree_nbytes(batch)
-                    except Exception:
+                    except Exception:  # ds-lint: allow[BROADEXC] best-effort byte gauge for the memory ledger; staging must not fail on it
                         pass
                 if self._span is not None:
                     try:
                         self._span(t0, time.perf_counter() - t0)
-                    except Exception:
+                    except Exception:  # ds-lint: allow[BROADEXC] telemetry hook; a broken trace exporter must not kill the staging worker
                         pass
                 self._put(batch)
                 if self._heartbeat is not None:
                     try:
                         self._heartbeat()
-                    except Exception:
+                    except Exception:  # ds-lint: allow[BROADEXC] telemetry hook; a broken watchdog must not kill the staging worker
                         pass
-        except BaseException as e:  # surfaced on the consumer side
+        except BaseException as e:  # ds-lint: allow[BROADEXC] stored and re-raised on the consumer side at the next __next__
             self._exc = e
         finally:
             self._put(_DONE)
@@ -136,7 +136,7 @@ class PrefetchLoader:
                 # a finished subsystem's age toward a stall verdict
                 try:
                     self._finished()
-                except Exception:
+                except Exception:  # ds-lint: allow[BROADEXC] telemetry hook; the worker is already exiting
                     pass
 
     def _put(self, item):

@@ -36,7 +36,7 @@ def _process_index():
     try:
         import jax
         return jax.process_index()
-    except Exception:
+    except Exception:  # ds-lint: allow[BROADEXC] logging must work before (or without) jax/distributed init; rank defaults to 0
         return 0
 
 

@@ -30,7 +30,7 @@ setup(
                 "capabilities (JAX/XLA/Pallas)",
     packages=find_packages(include=["deepspeed_tpu*", "op_builder*"]),
     scripts=["bin/dstpu", "bin/ds_report", "bin/ds_elastic",
-             "bin/ds_trace"],
+             "bin/ds_trace", "bin/ds_lint"],
     install_requires=["jax", "flax", "optax", "numpy"],
     python_requires=">=3.10",
 )

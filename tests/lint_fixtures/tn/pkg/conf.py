@@ -1,0 +1,9 @@
+from pkg.constants import GOOD_KEY, GOOD_KEY_DEFAULT
+
+
+def get_scalar_param(d, key, default):
+    return d.get(key, default)
+
+
+def parse(param_dict):
+    return get_scalar_param(param_dict, GOOD_KEY, GOOD_KEY_DEFAULT)

@@ -1,0 +1,3 @@
+"""CFGKEY clean fixture constants."""
+GOOD_KEY = "good_key"
+GOOD_KEY_DEFAULT = 1

@@ -1,0 +1,22 @@
+"""BROADEXC clean fixture."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def work():
+    raise RuntimeError("boom")
+
+
+def narrow():
+    try:
+        work()
+    except RuntimeError:
+        pass
+
+
+def logs_traceback():
+    try:
+        work()
+    except Exception:
+        logger.warning("work failed", exc_info=True)

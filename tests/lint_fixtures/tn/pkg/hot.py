@@ -1,0 +1,25 @@
+"""HOTSYNC + TRACECTL true-negative fixture: same shape, clean."""
+import jax
+import jax.numpy as jnp
+
+
+def train_step(x):
+    y = helper(x)
+    fence()
+    return y
+
+
+def helper(x):
+    return x * 2                  # no sync: clean
+
+
+def fence():
+    # declared fence site: the one allowed rendezvous
+    return jax.device_get(jnp.zeros(()))
+
+
+def traced_body(x):
+    return jnp.where(jnp.any(x > 0), x * 2, x)   # lax-native select
+
+
+traced_jit = jax.jit(traced_body)

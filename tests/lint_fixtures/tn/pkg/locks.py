@@ -1,0 +1,25 @@
+"""LOCKBLOCK clean fixture: durability work outside the lock; queue
+ops with escape hatches."""
+import os
+import threading
+
+
+class Writer:
+    def __init__(self, queue):
+        self._lock = threading.Lock()
+        self._queue = queue
+        self._buf = []
+
+    def good_fsync(self, fd):
+        with self._lock:
+            buf = list(self._buf)     # in-memory work only
+        os.fsync(fd)                  # durability outside the lock
+        return buf
+
+    def good_put(self, item):
+        with self._lock:
+            self._queue.put(item, block=False)
+
+    def string_replace_is_fine(self, s):
+        with self._lock:
+            return s.replace("a", "b")

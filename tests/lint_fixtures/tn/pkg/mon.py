@@ -1,0 +1,15 @@
+"""EVTSCHEMA clean fixture: emitted keys == documented keys."""
+import time
+
+SCHEMA_VERSION = 1
+
+
+def base_event(kind, step):
+    return {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind,
+            "step": step}
+
+
+def emit_boom(sink, step):
+    ev = base_event("boom", step)
+    ev["alpha"] = 1
+    sink(ev)

@@ -1,0 +1,12 @@
+from pkg.constants import GOOD_KEY, GOOD_KEY_DEFAULT, UNDOC_KEY
+
+
+def get_scalar_param(d, key, default):
+    return d.get(key, default)
+
+
+def parse(param_dict):
+    a = get_scalar_param(param_dict, GOOD_KEY, GOOD_KEY_DEFAULT)
+    b = get_scalar_param(param_dict, "literal_key", 2)   # CFGKEY: literal read
+    c = get_scalar_param(param_dict, UNDOC_KEY, 0)       # CFGKEY: no doc row
+    return a, b, c

@@ -1,0 +1,8 @@
+"""CFGKEY fixture constants: GOOD_KEY is read+documented; DEAD_KEY is
+declared but never referenced; UNDOC_KEY is read but undocumented."""
+GOOD_KEY = "good_key"
+GOOD_KEY_DEFAULT = 1
+DEAD_KEY = "dead_key"
+DEAD_KEY_DEFAULT = 0
+UNDOC_KEY = "undocumented_key"
+UNDOC_KEY_DEFAULT = 0

@@ -1,0 +1,37 @@
+"""BROADEXC fixture: silent swallow (finding), plus the three passing
+forms (re-raise / traceback log / annotation)."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def work():
+    raise RuntimeError("boom")
+
+
+def swallows():
+    try:
+        work()
+    except Exception:
+        pass          # BROADEXC finding
+
+
+def reraises():
+    try:
+        work()
+    except Exception:
+        raise
+
+
+def logs_traceback():
+    try:
+        work()
+    except Exception:
+        logger.exception("work failed")
+
+
+def annotated():
+    try:
+        work()
+    except Exception:  # ds-lint: allow[BROADEXC] fixture: deliberately ignored
+        pass

@@ -1,0 +1,34 @@
+"""HOTSYNC + TRACECTL true-positive fixture.
+
+`train_step` is the declared hot entrypoint, `fence` the declared
+fence site. `helper` syncs outside the fence (HOTSYNC); `traced_body`
+branches on a traced value inside a jitted function (TRACECTL).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def train_step(x):
+    y = helper(x)          # reaches a device_get outside the fence
+    s = jnp.sum(y)
+    v = float(s)           # host conversion of a devicey value
+    fence()
+    return y, v
+
+
+def helper(x):
+    return jax.device_get(x)      # HOTSYNC finding
+
+
+def fence():
+    # declared fence site: this sync is the contract
+    return jax.device_get(jnp.zeros(()))
+
+
+def traced_body(x):
+    if jnp.any(x > 0):            # TRACECTL finding
+        return x * 2
+    return x
+
+
+traced_jit = jax.jit(traced_body)

@@ -1,0 +1,17 @@
+"""LOCKBLOCK fixture: fsync and a blocking queue put under a lock."""
+import os
+import threading
+
+
+class Writer:
+    def __init__(self, queue):
+        self._lock = threading.Lock()
+        self._queue = queue
+
+    def bad_fsync(self, fd):
+        with self._lock:
+            os.fsync(fd)              # LOCKBLOCK finding
+
+    def bad_put(self, item):
+        with self._lock:
+            self._queue.put(item)     # LOCKBLOCK finding

@@ -1,0 +1,18 @@
+"""EVTSCHEMA fixture: `boom` emits `alpha` (documented) and `beta`
+(undocumented -> finding); the doc also lists a `ghost` kind no code
+emits (-> finding)."""
+import time
+
+SCHEMA_VERSION = 1
+
+
+def base_event(kind, step):
+    return {"v": SCHEMA_VERSION, "ts": time.time(), "kind": kind,
+            "step": step}
+
+
+def emit_boom(sink, step):
+    ev = base_event("boom", step)
+    ev["alpha"] = 1
+    ev["beta"] = 2
+    sink(ev)
