@@ -2138,7 +2138,8 @@ def bench_zero3_overlap():
     # gathered buffers actually DYING after use — are structural in
     # the scan/remat form and only measurable against a real
     # allocator; on TPU the ledger reconcile scores them.)
-    (_, stacked), = e_ov.state.params["h"].items()
+    from deepspeed_tpu.models.gpt2 import stacked_block_params
+    stacked = stacked_block_params(e_ov.state.params)
     stack_bytes = sum(
         int(np.prod(l.shape)) * l.dtype.itemsize
         for l in jax.tree_util.tree_leaves(stacked))
@@ -2292,6 +2293,128 @@ def bench_elastic_recovery():
         sup.close()
 
 
+def bench_serving_throughput():
+    """Serving A/B (ISSUE 12): iteration-level continuous batching vs
+    request-at-a-time serving, same engine, same paged KV cache, same
+    Poisson arrival stream. Also pins the two serving correctness
+    contracts inline: decode-step logits BIT-exact vs the training
+    forward (fp32, small-contraction regime — see docs/inference.md),
+    the `kv_cache` ledger category equal to the pool bytes with
+    per-request entries matching independent page arithmetic, and the
+    int8 weight-only engine within tolerance of fp32."""
+    from deepspeed_tpu.inference import (InferenceEngine, Request,
+                                         ServingLoop, serve_sequential)
+    from deepspeed_tpu.models.gpt2 import (GPT2ForCausalLM,
+                                           tiny_gpt2_config)
+
+    cfg = tiny_gpt2_config()
+    model = GPT2ForCausalLM(cfg)
+    r = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)})
+    inf_cfg = {"max_slots": 8, "prefill_chunk": 16, "sync_every": 8,
+               "max_new_tokens": 32,
+               "kv_cache": {"num_pages": 96, "page_size": 8}}
+    eng = InferenceEngine(cfg, params, {"inference": dict(inf_cfg)})
+
+    # -- decode-logits parity pin (fp32, total length <= 12 keeps both
+    # programs in XLA-CPU's same-kernel regime -> literal bit equality)
+    prompt = r.randint(0, cfg.vocab_size, size=7).astype(np.int32)
+    eng.start_request(0, prompt, max_new=5)
+    cur = list(prompt)
+    parity_exact = True
+    for _ in range(5):
+        lg = np.asarray(eng.decode_once()[0])
+        ref = np.asarray(model.apply(
+            params, np.asarray(cur, np.int32)[None, :], True))[0, -1]
+        parity_exact = parity_exact and np.array_equal(lg, ref)
+        cur.append(int(lg.argmax()))
+    assert parity_exact, \
+        "decode logits diverged bitwise from the training forward"
+
+    # -- kv_cache ledger vs independent page-pool arithmetic
+    cats = eng.monitor.ledger.totals()["hbm"]
+    ledger_exact = cats.get("kv_cache") == eng.cache.pool_bytes
+    expect_req = -(-(len(prompt) + 5) // eng.cache.page_size) * \
+        eng.cache.page_bytes
+    ledger_exact = ledger_exact and eng.cache.slot_bytes(0) == expect_req
+    assert ledger_exact, (cats.get("kv_cache"), eng.cache.pool_bytes,
+                          eng.cache.slot_bytes(0), expect_req)
+    eng.reset()
+
+    # -- int8 weight-only A/B on the same prompt
+    e8 = InferenceEngine(cfg, params, {"inference": dict(
+        inf_cfg, weight_bits=8, weight_quant_block=32)})
+    e8.start_request(0, prompt, max_new=5)
+    eng.start_request(0, prompt, max_new=5)
+    l8 = np.asarray(e8.decode_once()[0])
+    l32 = np.asarray(eng.decode_once()[0])
+    int8_maxdiff = float(np.abs(l8 - l32).max())
+    int8_greedy_match = bool(l8.argmax() == l32.argmax())
+    eng.reset()
+
+    # -- the Poisson arrival stream (identical for both legs)
+    n_req = 32
+    gaps = r.exponential(scale=0.004, size=n_req)
+    arrivals = np.cumsum(gaps)
+    lens = r.randint(4, 29, size=n_req)
+    news = r.randint(16, 33, size=n_req)
+    prompts = [r.randint(0, cfg.vocab_size, size=int(l)).astype(np.int32)
+               for l in lens]
+
+    def make_requests():
+        return [Request(rid=i, tokens=prompts[i].copy(),
+                        max_new_tokens=int(news[i]),
+                        arrival_time=float(arrivals[i]))
+                for i in range(n_req)]
+
+    def leg_metrics(loop):
+        done = loop.results
+        tokens = int(sum(len(q.out_tokens) for q in done))
+        wall = max(q.finished_at for q in done)
+        lats = sorted(loop.token_latencies)
+        pick = lambda p: lats[min(int(p * len(lats)), len(lats) - 1)]  # noqa: E731
+        return tokens, wall, {
+            "tokens_per_sec": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "requests": len(done),
+            "p50_token_ms": round(pick(0.50) * 1e3, 3),
+            "p99_token_ms": round(pick(0.99) * 1e3, 3),
+        }
+
+    # warmup both paths once (programs are AOT-compiled at engine
+    # build; this settles donation/layouts)
+    ServingLoop(eng).serve([Request(rid="w", tokens=prompts[0].copy(),
+                                    max_new_tokens=4)])
+    eng.reset()
+
+    seq_loop = serve_sequential(eng, make_requests())
+    seq_tokens, seq_wall, seq = leg_metrics(seq_loop)
+    eng.reset()
+    cont_loop = ServingLoop(eng)
+    cont_loop.serve(make_requests())
+    cont_tokens, cont_wall, cont = leg_metrics(cont_loop)
+
+    assert cont_tokens == seq_tokens, (cont_tokens, seq_tokens)
+    n_chips = max(len(jax.devices()), 1)
+    speedup = (cont_tokens / cont_wall) / (seq_tokens / seq_wall)
+    return {
+        "model": "gpt2-tiny", "requests": n_req,
+        "poisson_mean_interarrival_ms": 4.0,
+        "max_slots": 8,
+        "sequential": seq,
+        "continuous": cont,
+        "continuous_vs_sequential_speedup": round(speedup, 2),
+        "tokens_per_sec_per_chip": round(
+            cont_tokens / cont_wall / n_chips, 1),
+        "devices": n_chips,
+        "parity_bitexact_fp32": bool(parity_exact),
+        "kv_ledger_exact": bool(ledger_exact),
+        "int8_logits_maxdiff": int8_maxdiff,
+        "int8_greedy_match": int8_greedy_match,
+    }
+
+
 # Named bench legs (single source for both `--only` and the full-suite
 # extras; each returns one JSON-able dict). Order matters: the full
 # suite runs the TPU legs in this order, then the memory plan.
@@ -2316,6 +2439,7 @@ BENCH_LEGS = {
     "memory_ledger": bench_memory_ledger,
     "zero3_overlap": bench_zero3_overlap,
     "elastic_recovery": bench_elastic_recovery,
+    "serving_throughput": bench_serving_throughput,
 }
 
 

@@ -45,6 +45,16 @@ HOT_ENTRYPOINTS = (
     "deepspeed_tpu.ops.transformer.fused_ops:"
     "fused_bias_residual_layernorm",
     "deepspeed_tpu.ops.transformer.fused_ops:fused_bias_gelu",
+    # serving hot path (PR 12): the two AOT step builders (their inner
+    # functions are the compiled per-token programs), the sync-free
+    # dispatch helpers, and the serving loop's per-iteration step —
+    # everything between serving fences must stay sync-free just like
+    # the train loop
+    "deepspeed_tpu.inference.engine:InferenceEngine._build_decode_step",
+    "deepspeed_tpu.inference.engine:InferenceEngine._build_prefill_step",
+    "deepspeed_tpu.inference.engine:InferenceEngine.decode_block",
+    "deepspeed_tpu.inference.engine:InferenceEngine.prefill_chunk",
+    "deepspeed_tpu.inference.scheduler:ServingLoop.step",
 )
 
 # ----------------------------------------------------------------------
@@ -69,6 +79,10 @@ FENCE_SITES = (
     # per-step form was removed in PR 2; the dynamic guard tests would
     # catch it coming back per-step)
     "deepspeed_tpu.utils.timer:_device_sync",
+    # the serving fence (PR 12): ServingLoop._fence's one fused
+    # device_get of every slot's progress — the only rendezvous in the
+    # serving loop (tests/test_inference.py pins it dynamically)
+    "deepspeed_tpu.inference.engine:InferenceEngine.fetch_state",
 )
 
 # ----------------------------------------------------------------------
@@ -88,6 +102,10 @@ ATTR_TYPES = {
     "ledger": "deepspeed_tpu.monitor.memory:MemoryLedger",
     "tput_timer": "deepspeed_tpu.utils.timer:ThroughputTimer",
     "_scheduler": "deepspeed_tpu.runtime.zero.stage3:Zero3GatherScheduler",
+    "_infer": "deepspeed_tpu.inference.engine:InferenceEngine",
+    "_infer.cache": "deepspeed_tpu.inference.kv_cache:PagedKVCache",
+    "_infer.monitor": "deepspeed_tpu.monitor:Monitor",
+    "cache": "deepspeed_tpu.inference.kv_cache:PagedKVCache",
 }
 
 # ----------------------------------------------------------------------
@@ -157,6 +175,7 @@ EVENT_EMITTER_MODULE_PREFIXES = (
     "deepspeed_tpu.elasticity",
     "deepspeed_tpu.runtime.engine",
     "deepspeed_tpu.runtime.checkpoint",
+    "deepspeed_tpu.inference",
 )
 EVENT_SCHEMA_DOC = "docs/monitoring.md"
 EVENT_SCHEMA_BEGIN = "<!-- ds-lint:event-schema:begin -->"

@@ -180,6 +180,15 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("elastic runtime", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.inference import InferenceEngine  # noqa: F401
+        rows.append((
+            "inference engine",
+            f"{SUCCESS} AOT prefill+decode, paged KV cache, "
+            "continuous batching, int8 weights (inference block; "
+            "docs/inference.md)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("inference engine", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.analysis.rules import ALL_RULES
         from deepspeed_tpu.analysis import baseline as _bl
         bl_path = _bl.default_path(os.path.dirname(

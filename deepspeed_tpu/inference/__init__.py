@@ -1,0 +1,26 @@
+"""deepspeed_tpu.inference — the serving engine (docs/inference.md).
+
+  * InferenceEngine (engine.py): AOT-compiled prefill + single-token
+    decode programs, device-side sampling, zero per-token host sync.
+  * PagedKVCache (kv_cache.py): fixed-size pages in one preallocated
+    device pool, per-request page tables, host-side alloc/free at
+    serving fences, `kv_cache` memory-ledger category.
+  * ServingLoop / Request / serve_sequential (scheduler.py):
+    iteration-level continuous batching with chunked prefill
+    interleaving and EOS/max-tokens eviction.
+  * InferenceConfig (config.py): the `inference` config block.
+  * int8 weight-only quantization (quant.py): per-block-scale
+    kernels quantized once at load, dequant-in-matmul epilogue.
+"""
+
+from deepspeed_tpu.inference.config import (InferenceConfig,
+                                            InferenceConfigError)
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_cache import PagedKVCache
+from deepspeed_tpu.inference.scheduler import (Request, ServingLoop,
+                                               serve_sequential)
+
+__all__ = [
+    "InferenceEngine", "PagedKVCache", "ServingLoop", "Request",
+    "serve_sequential", "InferenceConfig", "InferenceConfigError",
+]

@@ -1,0 +1,108 @@
+"""`inference` config block parsing.
+
+    {"inference": {"max_slots": 8,
+                   "prefill_chunk": 64,
+                   "sync_every": 8,
+                   "max_new_tokens": 128,
+                   "max_seq_len": null,
+                   "eos_token_id": null,
+                   "top_k_max": 64,
+                   "seed": 0,
+                   "weight_bits": 32,
+                   "weight_quant_block": 64,
+                   "kv_cache": {"num_pages": 256, "page_size": 16}}}
+
+See the key-by-key commentary in runtime/constants.py (the
+"Inference/serving engine" section) and docs/inference.md. Validation
+follows the monitor-config convention: every bad value raises with the
+full dotted key name and the offending value.
+"""
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.runtime.config_utils import get_scalar_param
+
+
+class InferenceConfigError(Exception):
+    pass
+
+
+def _int(block, key, default, dotted):
+    v = get_scalar_param(block, key, default)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise InferenceConfigError(
+            f"{dotted} must be an integer, got {v!r}")
+
+
+def _pos_int(block, key, default, dotted, minimum=1):
+    v = _int(block, key, default, dotted)
+    if v < minimum:
+        raise InferenceConfigError(
+            f"{dotted} must be >= {minimum}, got {v}")
+    return v
+
+
+class InferenceConfig:
+    """Parsed + validated `inference` block."""
+
+    def __init__(self, param_dict=None):
+        block = (param_dict or {}).get(C.INFERENCE, {})
+        if not isinstance(block, dict):
+            raise InferenceConfigError(
+                f'"inference" must be a dict, got {block!r}')
+        self.max_slots = _pos_int(
+            block, C.INFERENCE_MAX_SLOTS, C.INFERENCE_MAX_SLOTS_DEFAULT,
+            "inference.max_slots")
+        self.prefill_chunk = _pos_int(
+            block, C.INFERENCE_PREFILL_CHUNK,
+            C.INFERENCE_PREFILL_CHUNK_DEFAULT, "inference.prefill_chunk")
+        self.sync_every = _pos_int(
+            block, C.INFERENCE_SYNC_EVERY, C.INFERENCE_SYNC_EVERY_DEFAULT,
+            "inference.sync_every")
+        self.max_new_tokens = _pos_int(
+            block, C.INFERENCE_MAX_NEW_TOKENS,
+            C.INFERENCE_MAX_NEW_TOKENS_DEFAULT,
+            "inference.max_new_tokens")
+        self.max_seq_len = get_scalar_param(
+            block, C.INFERENCE_MAX_SEQ_LEN, C.INFERENCE_MAX_SEQ_LEN_DEFAULT)
+        if self.max_seq_len is not None:
+            self.max_seq_len = _pos_int(
+                block, C.INFERENCE_MAX_SEQ_LEN, None,
+                "inference.max_seq_len")
+        self.eos_token_id = get_scalar_param(
+            block, C.INFERENCE_EOS_TOKEN_ID,
+            C.INFERENCE_EOS_TOKEN_ID_DEFAULT)
+        if self.eos_token_id is not None:
+            self.eos_token_id = _int(
+                block, C.INFERENCE_EOS_TOKEN_ID, None,
+                "inference.eos_token_id")
+        self.top_k_max = _pos_int(
+            block, C.INFERENCE_TOP_K_MAX, C.INFERENCE_TOP_K_MAX_DEFAULT,
+            "inference.top_k_max")
+        self.seed = _int(block, C.INFERENCE_SEED,
+                         C.INFERENCE_SEED_DEFAULT, "inference.seed")
+        self.weight_bits = _int(
+            block, C.INFERENCE_WEIGHT_BITS,
+            C.INFERENCE_WEIGHT_BITS_DEFAULT, "inference.weight_bits")
+        if self.weight_bits not in C.INFERENCE_WEIGHT_BITS_VALID:
+            raise InferenceConfigError(
+                "inference.weight_bits must be one of "
+                f"{C.INFERENCE_WEIGHT_BITS_VALID}, got {self.weight_bits}")
+        self.weight_quant_block = _pos_int(
+            block, C.INFERENCE_WEIGHT_QUANT_BLOCK,
+            C.INFERENCE_WEIGHT_QUANT_BLOCK_DEFAULT,
+            "inference.weight_quant_block")
+
+        kv = block.get(C.INFERENCE_KV_CACHE, {})
+        if not isinstance(kv, dict):
+            raise InferenceConfigError(
+                f'"inference.kv_cache" must be a dict, got {kv!r}')
+        # >= 2: page 0 is the reserved scratch page, so at least one
+        # page must remain allocatable
+        self.kv_num_pages = _pos_int(
+            kv, C.INFERENCE_KV_NUM_PAGES, C.INFERENCE_KV_NUM_PAGES_DEFAULT,
+            "inference.kv_cache.num_pages", minimum=2)
+        self.kv_page_size = _pos_int(
+            kv, C.INFERENCE_KV_PAGE_SIZE, C.INFERENCE_KV_PAGE_SIZE_DEFAULT,
+            "inference.kv_cache.page_size")

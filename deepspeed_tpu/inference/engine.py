@@ -1,0 +1,465 @@
+"""InferenceEngine — AOT prefill + single-token decode over a paged
+KV cache, with device-side sampling and zero per-token host sync.
+
+Exactly TWO programs are compiled per model (ahead of time, at engine
+construction — no trace-on-first-request latency spike):
+
+  * the **prefill** step: one prompt chunk ([1, prefill_chunk] tokens)
+    through the stack, writing each layer's K/V into the request's
+    cache pages and attending over everything cached so far (chunked,
+    so a long prompt interleaves with decode instead of stalling it);
+  * the **decode** step: one token for EVERY request slot at once
+    ([max_slots] lockstep), paged-attention over each slot's cached
+    prefix, logits through the tied head, and greedy /
+    temperature+top-k sampling device-side — the sampled token, the
+    EOS/max-tokens finish flags, and the output ring all stay on
+    device, so the host dispatches `sync_every` decode iterations
+    back-to-back and reads NOTHING until the serving fence (the PR-2
+    async-dispatch convention applied to serving).
+
+The forward math deliberately mirrors the training path operation for
+operation (the same flax submodules applied to the same param leaves,
+the same einsum phrasings, the same fp32 softmax with -1e30 masking),
+so decode logits are BIT-EXACT against the training forward on the
+same prefix in fp32 — parity is pinned by tests/test_inference.py, the
+serving bench leg, and the training/serving drift that convention
+prevents is the point.
+
+Weight-only int8 serving (`inference.weight_bits: 8`) quantises the
+projection kernels once at load (inference/quant.py) and the dense
+application below switches onto the dequant-in-matmul epilogue;
+everything else (cache, scheduler, sampling) is unchanged.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.inference.config import InferenceConfig
+from deepspeed_tpu.inference.kv_cache import PagedKVCache
+from deepspeed_tpu.inference.quant import (KERNEL_SCALE, int8_matmul,
+                                           quantize_param_tree)
+from deepspeed_tpu.monitor import DeepSpeedMonitorConfig, Monitor
+from deepspeed_tpu.monitor import memory as memory_mod
+from deepspeed_tpu.utils.logging import logger
+
+
+# ----------------------------------------------------------------------
+# training-math twins: the same flax modules the training forward runs,
+# applied to extracted param leaves (bit-exact by construction)
+# ----------------------------------------------------------------------
+def _ln_apply(cfg, p, x):
+    """nn.LayerNorm exactly as GPT2Block builds it (fp32 stats)."""
+    return nn.LayerNorm(
+        epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
+        param_dtype=cfg.param_dtype).apply({"params": p}, x)
+
+
+def _dense_apply(cfg, p, x, quant_block):
+    """nn.Dense as GPT2Block builds it — or, when the leaf carries a
+    KERNEL_SCALE, the int8 dequant-in-matmul epilogue."""
+    if KERNEL_SCALE in p:
+        y = int8_matmul(x.astype(cfg.dtype), p["kernel"],
+                        p[KERNEL_SCALE], quant_block, cfg.dtype)
+        return y + p["bias"].astype(cfg.dtype)
+    return nn.Dense(
+        p["kernel"].shape[-1], dtype=cfg.dtype,
+        param_dtype=cfg.param_dtype).apply(
+            {"params": {"kernel": p["kernel"], "bias": p["bias"]}}, x)
+
+
+def paged_attention(q, kc, vc, q_pos, kv_limit):
+    """Causal attention of q [B, Tq, H, D] against a gathered page
+    window kc/vc [B, Tk, H, D], phrased exactly like the training
+    path's `dense_attention` (same einsum strings, fp32 softmax,
+    -1e30 where-masking) so the result is bit-exact vs a contiguous
+    cache: key positions are their indices, queries sit at absolute
+    positions `q_pos` [B, Tq], and keys beyond `kv_limit` [B] (pages
+    not yet written / scratch) are price-masked AND value-zeroed — a
+    masked key contributes an exact +0.0 to every reduction, which is
+    what keeps the longer padded reductions bit-identical to the
+    unpadded training ones."""
+    sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, kc).astype(jnp.float32)
+    scores = scores * sm_scale
+    kpos = jnp.arange(kc.shape[1])
+    mask = kpos[None, None, None, :] <= q_pos[:, None, :, None]
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs.astype(vc.dtype)
+    # scratch/unwritten pages can hold garbage; zero their values so
+    # the 0-probability product is exactly 0 regardless
+    v_ok = (kpos[None, :] <= kv_limit[:, None])[:, :, None, None]
+    vc = jnp.where(v_ok, vc, jnp.zeros((), vc.dtype))
+    # PV phrased as a (b, h)-batched matmul rather than the einsum
+    # string: measured on XLA-CPU this contraction accumulates the
+    # real-key prefix in the same order at every padded width, which
+    # is what keeps decode logits BIT-identical to the training
+    # forward's unpadded attention (the einsum lowering is 1 ulp off
+    # once the padded K dim changes the blocking)
+    out = jnp.matmul(probs, vc.transpose(0, 2, 1, 3))
+    return out.transpose(0, 2, 1, 3)
+
+
+def _block_paged(cfg, lp, hidden, kl, vl, tables, positions, valid,
+                 kv_limit, page_size, quant_block):
+    """One pre-LN transformer block (GPT2Block's unfused math, op for
+    op) over hidden [B, Tq, C], writing this chunk's K/V into the
+    layer's page pool (kl/vl: [P, page, H, D]) and attending through
+    the page tables ([B, max_pages]). Rows with valid=False (inactive
+    decode slots, prefill pad rows) divert their writes to scratch
+    page 0."""
+    b, t, c = hidden.shape
+    h, d = cfg.n_head, cfg.head_dim
+
+    x = _ln_apply(cfg, lp["ln_1"], hidden).astype(cfg.dtype)
+    qkv = _dense_apply(cfg, lp["c_attn"], x, quant_block)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, d)
+    k = k.reshape(b, t, h, d)
+    v = v.reshape(b, t, h, d)
+
+    # write-before-read: the chunk's own keys are part of its causal
+    # window (a query attends to itself, like the training mask)
+    pidx = positions // page_size
+    off = positions % page_size
+    phys = jnp.take_along_axis(tables, pidx, axis=1)
+    phys = jnp.where(valid, phys, 0).reshape(-1)
+    off = off.reshape(-1)
+    kl = kl.at[phys, off].set(k.reshape(b * t, h, d))
+    vl = vl.at[phys, off].set(v.reshape(b * t, h, d))
+
+    kc = kl[tables].reshape(b, -1, h, d)
+    vc = vl[tables].reshape(b, -1, h, d)
+    attn = paged_attention(q, kc, vc, positions, kv_limit)
+    attn = attn.reshape(b, t, c)
+    attn = _dense_apply(cfg, lp["c_proj"], attn, quant_block)
+    hidden = hidden + attn
+
+    y = _ln_apply(cfg, lp["ln_2"], hidden).astype(cfg.dtype)
+    y = _dense_apply(cfg, lp["c_fc"], y, quant_block)
+    y = nn.gelu(y, approximate=True)
+    y = _dense_apply(cfg, lp["mlp_c_proj"], y, quant_block)
+    return hidden + y, kl, vl
+
+
+class InferenceEngine:
+    """Serving engine for a GPT-2 family model.
+
+    Construction compiles the two programs AOT against the configured
+    shapes; `start_request`/`prefill_chunk`/`activate_slot` manage
+    slots (fence-side host work), `decode_block` dispatches N sync-free
+    decode iterations, and `fetch_state` is the ONE host<->device
+    rendezvous (the serving fence — declared in the ds_lint registry
+    and pinned by the dynamic guard test)."""
+
+    def __init__(self, model_config, params, config=None, rank=0):
+        self.model_config = model_config
+        cfg = InferenceConfig(config or {})
+        self.config = cfg
+        self.monitor = Monitor(self, DeepSpeedMonitorConfig(config or {}))
+        self._host_steps = 0
+        self.micro_steps = 0
+
+        max_seq = model_config.n_positions
+        if cfg.max_seq_len is not None:
+            max_seq = min(max_seq, cfg.max_seq_len)
+        self.max_seq_len = max_seq
+        max_pages = -(-max_seq // cfg.kv_page_size)
+
+        if cfg.weight_bits == 8:
+            params = quantize_param_tree(params, cfg.weight_quant_block)
+            logger.info(
+                "inference: int8 weight-only quantization applied "
+                f"(block {cfg.weight_quant_block} along the "
+                "contraction dim)")
+        self._params = params
+        self.cache = PagedKVCache(
+            n_layer=model_config.n_layer, n_head=model_config.n_head,
+            head_dim=model_config.head_dim, num_pages=cfg.kv_num_pages,
+            page_size=cfg.kv_page_size, max_slots=cfg.max_slots,
+            max_pages_per_slot=max_pages,
+            dtype=np.dtype(model_config.dtype),
+            ledger=self.monitor.ledger)
+        self.monitor.ledger.register_tree(
+            memory_mod.CAT_PARAMS, "inference.params", params)
+
+        self._tables_version = self.cache.table_version
+        self._state = self._fresh_state()
+        self._decode = self._build_decode_step()
+        self._prefill = self._build_prefill_step()
+        self._last_logits = None
+
+    # ------------------------------------------------------------------
+    # state
+    # ------------------------------------------------------------------
+    def _fresh_state(self):
+        cfg, mc = self.config, self.model_config
+        s, w = cfg.max_slots, cfg.max_new_tokens
+        pool = (mc.n_layer, self.cache.num_pages, self.cache.page_size,
+                mc.n_head, mc.head_dim)
+        return {
+            "k_pool": jnp.zeros(pool, mc.dtype),
+            "v_pool": jnp.zeros(pool, mc.dtype),
+            "tables": jnp.asarray(self.cache.tables),
+            "pos": jnp.zeros((s,), jnp.int32),
+            "cur_token": jnp.zeros((s,), jnp.int32),
+            "active": jnp.zeros((s,), bool),
+            "finished_eos": jnp.zeros((s,), bool),
+            "n_gen": jnp.zeros((s,), jnp.int32),
+            "out_tokens": jnp.zeros((s, w), jnp.int32),
+            "max_new": jnp.full((s,), w, jnp.int32),
+            "temperature": jnp.zeros((s,), jnp.float32),
+            "top_k": jnp.zeros((s,), jnp.int32),
+            "eos": jnp.full((s,), -1, jnp.int32),
+            "rng": jax.random.PRNGKey(cfg.seed),
+            "step": jnp.zeros((), jnp.int32),
+        }
+
+    def reset(self):
+        """Drop all slots and cached pages (bench A/B hygiene)."""
+        for slot in self.cache.slots():
+            self.cache.free(slot)
+        self._state = self._fresh_state()
+        self._tables_version = self.cache.table_version
+
+    # ------------------------------------------------------------------
+    # the two AOT programs
+    # ------------------------------------------------------------------
+    def _build_decode_step(self):
+        cfg, mc = self.config, self.model_config
+        qb = cfg.weight_quant_block
+        page = self.cache.page_size
+        s = cfg.max_slots
+        out_w = cfg.max_new_tokens
+        top_k_cap = min(cfg.top_k_max, mc.vocab_size)
+
+        def sample(logits, state):
+            l32 = logits.astype(jnp.float32)
+            greedy = jnp.argmax(l32, axis=-1).astype(jnp.int32)
+            vals, _ = jax.lax.top_k(l32, top_k_cap)
+            idx = jnp.clip(state["top_k"] - 1, 0, top_k_cap - 1)
+            kth = jnp.take_along_axis(vals, idx[:, None], axis=1)[:, 0]
+            masked = jnp.where(
+                (state["top_k"] > 0)[:, None] & (l32 < kth[:, None]),
+                -jnp.inf, l32)
+            temp = state["temperature"]
+            scaled = masked / jnp.maximum(temp, 1e-6)[:, None]
+            key = jax.random.fold_in(state["rng"], state["step"])
+            keys = jax.vmap(jax.random.fold_in,
+                            in_axes=(None, 0))(key, jnp.arange(s))
+            drawn = jax.vmap(jax.random.categorical)(keys, scaled)
+            return jnp.where(temp > 0.0, drawn.astype(jnp.int32), greedy)
+
+        def decode_fn(params, state):
+            active = state["active"]
+            pos = state["pos"]
+            wte, wpe = params["wte"], params["wpe"]
+            # embed_tokens' math for a [S, 1] "sequence" at absolute
+            # positions `pos`
+            hidden = wte[state["cur_token"]].astype(mc.dtype) + \
+                wpe[pos].astype(mc.dtype)
+            hidden = hidden[:, None, :]
+            positions = pos[:, None]
+            valid = active[:, None]
+            from deepspeed_tpu.models.gpt2 import stacked_block_params
+
+            def layer(h, xs):
+                lp, kl, vl = xs
+                h, kl, vl = _block_paged(
+                    mc, lp, h, kl, vl, state["tables"], positions,
+                    valid, pos, page, qb)
+                return h, (kl, vl)
+
+            stacked = stacked_block_params(params)
+            hidden, (k_pool, v_pool) = jax.lax.scan(
+                layer, hidden, (stacked, state["k_pool"],
+                                state["v_pool"]))
+            hidden = _ln_apply(mc, params["ln_f"], hidden)
+            logits = jnp.einsum("btc,vc->btv", hidden.astype(mc.dtype),
+                                wte.astype(mc.dtype))[:, 0]
+            next_tok = sample(logits, state)
+
+            n = state["n_gen"]
+            idx = jnp.clip(n, 0, out_w - 1)
+            rows = jnp.arange(s)
+            prev = state["out_tokens"][rows, idx]
+            out = state["out_tokens"].at[rows, idx].set(
+                jnp.where(active, next_tok, prev))
+            n2 = n + active.astype(jnp.int32)
+            hit_eos = active & (next_tok == state["eos"])
+            hit_max = active & (n2 >= state["max_new"])
+            new_state = dict(
+                state,
+                k_pool=k_pool, v_pool=v_pool,
+                pos=pos + active.astype(jnp.int32),
+                cur_token=jnp.where(active, next_tok,
+                                    state["cur_token"]),
+                active=active & ~(hit_eos | hit_max),
+                finished_eos=state["finished_eos"] | hit_eos,
+                n_gen=n2,
+                out_tokens=out,
+                step=state["step"] + 1,
+            )
+            return new_state, logits
+
+        return jax.jit(decode_fn, donate_argnums=(1,)).lower(
+            self._params, self._state).compile()
+
+    def _build_prefill_step(self):
+        cfg, mc = self.config, self.model_config
+        qb = cfg.weight_quant_block
+        page = self.cache.page_size
+        chunk = cfg.prefill_chunk
+
+        def prefill_fn(params, k_pool, v_pool, page_row, tokens, start,
+                       n_valid):
+            wte, wpe = params["wte"], params["wpe"]
+            posv = start + jnp.arange(chunk, dtype=jnp.int32)
+            valid = jnp.arange(chunk) < n_valid
+            hidden = wte[tokens].astype(mc.dtype) + \
+                wpe[posv].astype(mc.dtype)
+            hidden = hidden[None]
+            positions = posv[None]
+            kv_limit = (start + n_valid - 1)[None]
+            tables = page_row[None]
+            from deepspeed_tpu.models.gpt2 import stacked_block_params
+
+            def layer(h, xs):
+                lp, kl, vl = xs
+                h, kl, vl = _block_paged(
+                    mc, lp, h, kl, vl, tables, positions, valid[None],
+                    kv_limit, page, qb)
+                return h, (kl, vl)
+
+            stacked = stacked_block_params(params)
+            _, (k_pool, v_pool) = jax.lax.scan(
+                layer, hidden, (stacked, k_pool, v_pool))
+            return k_pool, v_pool
+
+        st = self._state
+        args = (self._params, st["k_pool"], st["v_pool"],
+                jnp.asarray(self.cache.tables[0]),
+                jnp.zeros((chunk,), jnp.int32),
+                jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        return jax.jit(prefill_fn, donate_argnums=(1, 2)).lower(
+            *args).compile()
+
+    # ------------------------------------------------------------------
+    # fence-side slot management (host work, runs between blocks)
+    # ------------------------------------------------------------------
+    def push_tables(self):
+        """Upload the page tables iff they changed since the last
+        push — callers invoke this liberally at fences and pay one
+        transfer per actual mutation batch."""
+        if self._tables_version != self.cache.table_version:
+            self._state["tables"] = jnp.asarray(self.cache.tables)
+            self._tables_version = self.cache.table_version
+
+    def prefill_chunk(self, slot, tokens, start):
+        """Cache `tokens` (<= prefill_chunk of them) for `slot` at
+        positions [start, start+len). Pages must already be ensured."""
+        n = len(tokens)
+        buf = np.zeros((self.config.prefill_chunk,), np.int32)
+        buf[:n] = tokens
+        st = self._state
+        k, v = self._prefill(
+            self._params, st["k_pool"], st["v_pool"],
+            jnp.asarray(self.cache.tables[slot]), jnp.asarray(buf),
+            jnp.asarray(start, jnp.int32), jnp.asarray(n, jnp.int32))
+        st["k_pool"], st["v_pool"] = k, v
+        self._host_steps += 1
+
+    def activate_slot(self, slot, cur_token, pos, max_new, temperature,
+                      top_k, eos):
+        """Flip a fully-prefilled slot live for the decode batch."""
+        st = self._state
+        st["cur_token"] = st["cur_token"].at[slot].set(int(cur_token))
+        st["pos"] = st["pos"].at[slot].set(int(pos))
+        st["active"] = st["active"].at[slot].set(True)
+        st["finished_eos"] = st["finished_eos"].at[slot].set(False)
+        st["n_gen"] = st["n_gen"].at[slot].set(0)
+        st["max_new"] = st["max_new"].at[slot].set(int(max_new))
+        st["temperature"] = st["temperature"].at[slot].set(
+            float(temperature))
+        st["top_k"] = st["top_k"].at[slot].set(int(top_k))
+        st["eos"] = st["eos"].at[slot].set(
+            -1 if eos is None else int(eos))
+
+    def start_request(self, slot, prompt, max_new, temperature=0.0,
+                      top_k=0, eos=None):
+        """Admit + fully prefill + activate one request in one call
+        (test/bench convenience; ServingLoop does the same piecewise,
+        chunk-interleaved with decode)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        t = len(prompt)
+        if t < 1:
+            raise ValueError("empty prompt")
+        if t + max_new > self.max_seq_len:
+            raise ValueError(
+                f"prompt ({t}) + max_new_tokens ({max_new}) exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        if max_new > self.config.max_new_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new} exceeds the device output "
+                "ring width inference.max_new_tokens="
+                f"{self.config.max_new_tokens}")
+        if top_k > self.config.top_k_max:
+            raise ValueError(
+                f"top_k {top_k} exceeds the compiled sampling cap "
+                f"inference.top_k_max={self.config.top_k_max}")
+        self.cache.admit(slot, t + max_new)
+        chunk = self.config.prefill_chunk
+        n_prefill = t - 1
+        # direct (scheduler-less) use runs decode_block without a
+        # fence-side capacity step, so assign the worst case up front;
+        # ServingLoop allocates incrementally instead
+        self.cache.ensure(slot, t + max_new)
+        self.push_tables()
+        for start in range(0, n_prefill, chunk):
+            end = min(start + chunk, n_prefill)
+            self.prefill_chunk(slot, prompt[start:end], start)
+        self.activate_slot(slot, prompt[-1], t - 1, max_new,
+                           temperature, top_k, eos)
+
+    def ensure_decode_capacity(self, slot, known_pos, iters):
+        """Assign pages covering `iters` more positions for a live
+        slot before a decode block (reservation-backed: cannot fail)."""
+        worst = self.cache.reserved_tokens(slot)
+        self.cache.ensure(slot, min(known_pos + iters, worst))
+
+    # ------------------------------------------------------------------
+    # the hot dispatch loop + the serving fence
+    # ------------------------------------------------------------------
+    def decode_block(self, n):
+        """Dispatch n decode iterations back-to-back — no host sync,
+        no device_get, nothing read until `fetch_state` (the dynamic
+        guard test and ds_lint's HOTSYNC rule both pin this)."""
+        st = self._state
+        logits = self._last_logits
+        for _ in range(n):
+            st, logits = self._decode(self._params, st)
+        self._state = st
+        self._last_logits = logits
+        self._host_steps += n
+
+    def decode_once(self):
+        """One decode iteration, returning the pre-sampling logits
+        [max_slots, vocab] (parity tests read these)."""
+        st, logits = self._decode(self._params, self._state)
+        self._state = st
+        self._last_logits = logits
+        self._host_steps += 1
+        return logits
+
+    def fetch_state(self):
+        """THE serving fence: one fused device_get of the per-slot
+        progress the scheduler needs (active flags, eos flags,
+        positions, generated counts, output rings)."""
+        st = self._state
+        active, eos, pos, n_gen, out = jax.device_get(
+            (st["active"], st["finished_eos"], st["pos"], st["n_gen"],
+             st["out_tokens"]))
+        return {"active": active, "finished_eos": eos, "pos": pos,
+                "n_gen": n_gen, "out_tokens": out}

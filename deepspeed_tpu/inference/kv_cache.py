@@ -1,0 +1,195 @@
+"""Device-resident paged KV cache (PagedAttention-style block tables).
+
+The serving engine never materialises one contiguous [T, H, D] KV
+buffer per request — at high slot counts the padding-to-max waste is
+the first thing that OOMs a serving chip. Instead a single preallocated
+pool of fixed-size pages
+
+    k_pool / v_pool : [n_layer, num_pages, page_size, n_head, head_dim]
+
+is shared by every request; each request slot owns a page table
+(row of physical page ids) and positions map to (physical page,
+offset) by plain index math inside the compiled programs. Physical
+page 0 is a reserved scratch page: masked writes (inactive decode
+slots, prefill pad rows) are diverted there instead of being
+predicated away, so the compiled step stays branch-free.
+
+Allocation is host-side and happens only at serving fences (request
+admission / chunk reservation / finish) — never inside the dispatch
+loop. Admission reserves a request's worst-case page count up front
+(`can_admit`), so a request that was admitted can never fail an
+allocation mid-flight; pages are still *assigned* incrementally as the
+sequence actually grows, which is what the ledger reports.
+
+Ledger integration (the PR-8 contract): the pool registers itself
+under the `kv_cache` category — one dynamic `pool.unallocated` entry
+plus one dynamic entry per live request — so the category total always
+equals the true preallocated pool bytes while `top_buffers` and the
+category meta give per-request byte attribution, and `oom_hints` can
+name `inference.kv_cache.num_pages` when the cache dominates.
+"""
+
+import numpy as np
+
+from deepspeed_tpu.monitor import memory as memory_mod
+
+
+class PagedKVCache:
+    """Host-side page allocator + device pool shapes for one engine.
+
+    The device pool arrays themselves live in the engine's decode
+    state (they are donated through the compiled steps); this object
+    owns the page *tables* (numpy source of truth, staged to device by
+    the engine after fence-side mutations) and the free-list math.
+    """
+
+    def __init__(self, n_layer, n_head, head_dim, num_pages, page_size,
+                 max_slots, max_pages_per_slot, dtype=np.float32,
+                 ledger=None):
+        if max_pages_per_slot < 1:
+            raise ValueError(
+                f"max_pages_per_slot must be >= 1, got {max_pages_per_slot}")
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved scratch "
+                f"page), got {num_pages}")
+        self.n_layer = int(n_layer)
+        self.n_head = int(n_head)
+        self.head_dim = int(head_dim)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.max_slots = int(max_slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        self.dtype = np.dtype(dtype)
+        # bytes of ONE page across K+V and all layers: the unit every
+        # accounting statement below is phrased in
+        self.page_bytes = (2 * self.n_layer * self.page_size *
+                           self.n_head * self.head_dim *
+                           self.dtype.itemsize)
+        self.pool_bytes = self.num_pages * self.page_bytes
+        # page 0 = scratch; pages 1..num_pages-1 allocatable (LIFO free
+        # list: recently freed pages are re-assigned first, which keeps
+        # the working set compact)
+        self._free = list(range(self.num_pages - 1, 0, -1))
+        self._reserved = {}        # slot -> reserved page credit (int)
+        self._pages = {}           # slot -> [physical page ids]
+        self._names = {}           # slot -> ledger entry name
+        # host source of truth for the device page tables; scratch page
+        # 0 everywhere a slot has no page yet. `table_version` bumps on
+        # every mutation so the engine uploads the table only when it
+        # actually changed (push_tables is called liberally at fences)
+        self.tables = np.zeros((self.max_slots, self.max_pages_per_slot),
+                               np.int32)
+        self.table_version = 0
+        self._ledger = ledger
+        self._ledger_tokens = {}
+        if ledger is not None:
+            ledger.register_dynamic(
+                memory_mod.CAT_KV, "pool.unallocated",
+                lambda: self.pool_bytes - self.allocated_bytes(),
+                meta={"num_pages": self.num_pages,
+                      "page_size": self.page_size})
+
+    # -- accounting -----------------------------------------------------
+    def pages_for_tokens(self, n_tokens):
+        """Pages needed to hold positions [0, n_tokens): the ONE
+        ceil-division expression of the capacity contract (tests pin
+        ledger bytes against independent uses of this arithmetic)."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def free_pages(self):
+        return len(self._free)
+
+    def reserved_unallocated(self):
+        """Pages promised to admitted requests but not yet assigned
+        (admit() and free() keep _reserved/_pages in lockstep)."""
+        return sum(max(self._reserved[s] - len(p), 0)
+                   for s, p in self._pages.items())
+
+    def slots(self):
+        """Admitted slot ids (live requests)."""
+        return list(self._pages)
+
+    def reserved_tokens(self, slot):
+        """Token capacity of `slot`'s admission reservation."""
+        return self._reserved.get(slot, 0) * self.page_size
+
+    def allocated_pages(self, slot):
+        return len(self._pages.get(slot, ()))
+
+    def slot_bytes(self, slot):
+        return self.allocated_pages(slot) * self.page_bytes
+
+    def allocated_bytes(self):
+        return sum(len(p) for p in self._pages.values()) * self.page_bytes
+
+    # -- admission / growth / release -----------------------------------
+    def can_admit(self, n_tokens_worst_case):
+        """True when a request that may grow to n_tokens_worst_case
+        positions fits: its worst-case pages AND every other live
+        request's still-unassigned reservation must be coverable by the
+        free list — admitted requests never fail mid-flight."""
+        need = self.pages_for_tokens(n_tokens_worst_case)
+        if need > self.max_pages_per_slot:
+            return False
+        return need + self.reserved_unallocated() <= len(self._free)
+
+    def admit(self, slot, n_tokens_worst_case, name=None):
+        """Reserve worst-case capacity for `slot` (no pages assigned
+        yet) and open its ledger entry."""
+        if slot in self._pages or slot in self._reserved:
+            raise ValueError(f"slot {slot} is already admitted")
+        if not self.can_admit(n_tokens_worst_case):
+            raise RuntimeError(
+                f"kv cache cannot admit {n_tokens_worst_case} tokens: "
+                f"{len(self._free)} free pages, "
+                f"{self.reserved_unallocated()} already reserved "
+                "(raise inference.kv_cache.num_pages)")
+        self._reserved[slot] = self.pages_for_tokens(n_tokens_worst_case)
+        self._pages[slot] = []
+        self._names[slot] = name or f"slot{slot}"
+        if self._ledger is not None:
+            # the slot id keys the entry: request ids are caller-chosen
+            # and two live requests may share one — a name collision
+            # would let the first free() release the second's entry and
+            # break the category-total == pool-bytes invariant
+            self._ledger_tokens[slot] = self._ledger.register_dynamic(
+                memory_mod.CAT_KV,
+                f"request.s{slot}.{self._names[slot]}",
+                (lambda s: lambda: self.slot_bytes(s))(slot),
+                meta={"slot": int(slot),
+                      "request": self._names[slot]})
+
+    def ensure(self, slot, n_tokens):
+        """Assign pages so `slot` can hold positions [0, n_tokens).
+        Within the admission reservation this cannot fail; beyond it,
+        it raises (the scheduler sizes reservations so it never asks)."""
+        if slot not in self._pages:
+            raise ValueError(f"slot {slot} is not admitted")
+        need = self.pages_for_tokens(n_tokens)
+        pages = self._pages[slot]
+        if need > self._reserved[slot]:
+            raise RuntimeError(
+                f"slot {slot}: {n_tokens} tokens exceeds the admission "
+                f"reservation of {self._reserved[slot]} pages")
+        while len(pages) < need:
+            phys = self._free.pop()
+            pages.append(phys)
+            self.tables[slot, len(pages) - 1] = phys
+            self.table_version += 1
+        return pages
+
+    def free(self, slot):
+        """Return `slot`'s pages to the free list, drop its
+        reservation, close its ledger entry, and reset its table row to
+        the scratch page."""
+        pages = self._pages.pop(slot, [])
+        self._free.extend(reversed(pages))
+        self._reserved.pop(slot, None)
+        self._names.pop(slot, None)
+        self.tables[slot, :] = 0
+        self.table_version += 1
+        token = self._ledger_tokens.pop(slot, None)
+        if token is not None and self._ledger is not None:
+            self._ledger.release(token)
+        return len(pages)

@@ -278,6 +278,17 @@ def embed_tokens(cfg: GPT2Config, wte, wpe, input_ids):
         wpe[:t][None, :, :].astype(cfg.dtype)
 
 
+def stacked_block_params(params):
+    """The nn.scan cell's stacked [n_layer, ...] param subtree — the
+    single auto-named child under "h" (GPT2Block_0, or
+    CheckpointGPT2Block_0 under remat; same leaves either way). The
+    ONE place that naming knowledge lives: the ZeRO-3 scheduled loss
+    and the inference engine's layer scan both reconstruct the block
+    stack through this."""
+    (_, stacked), = params["h"].items()
+    return stacked
+
+
 class GPT2LMHeadModel(nn.Module):
     """GPT-2 with tied-embedding LM head; returns logits."""
     config: GPT2Config
@@ -508,10 +519,7 @@ class GPT2ForCausalLM:
         wpe = sched.gather(params["wpe"], name="wpe")
         hidden = embed_tokens(cfg, wte, wpe, input_ids)
 
-        # the nn.scan cell's stacked [L, ...] params sit under the
-        # single auto-named child of "h" (GPT2Block_0 /
-        # CheckpointGPT2Block_0 under remat — same leaves either way)
-        (_, stacked), = params["h"].items()
+        stacked = stacked_block_params(params)
         block = GPT2Block(cfg)
 
         def body(lp, h, rng_k):

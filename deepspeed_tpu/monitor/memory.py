@@ -18,6 +18,11 @@ pure index math — NO device sync anywhere in this module):
   host_opt_state  ZeRO-Offload CPU-Adam moments in host RAM
   wire            compressed-wire state: device residual / device flat
                   param copy / host shadow
+  kv_cache        the serving engine's preallocated paged KV pool —
+                  one DYNAMIC entry per live request (its allocated
+                  pages) plus the unallocated remainder, so the
+                  category total is always the true pool bytes
+                  (inference/kv_cache.py)
   ckpt_snapshot   checkpoint snapshot double-buffers — alive only
                   between the jitted snapshot and the writer's commit
   prefetch        staged batches queued ahead of the step loop
@@ -63,14 +68,18 @@ CAT_WIRE = "wire"
 CAT_CKPT = "ckpt_snapshot"
 CAT_PREFETCH = "prefetch"
 CAT_PIPE = "pipe_buffers"
+CAT_KV = "kv_cache"
 
 # canonical ordering for stacked rendering (Perfetto counter tracks,
 # event dicts): state groups first, transients last (zero3_gather —
 # the stage-3 scheduler's live gathered-param prefetch window — sits
-# with the state groups: it is persistent working memory of the step)
+# with the state groups: it is persistent working memory of the step;
+# kv_cache — the serving engine's preallocated page pool — likewise:
+# the pool is resident for the engine's lifetime, with per-request
+# entries carving it up)
 CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS, CAT_ZERO3,
-              CAT_HOST_MASTER, CAT_HOST_OPT, CAT_WIRE, CAT_CKPT,
-              CAT_PREFETCH, CAT_PIPE)
+              CAT_KV, CAT_HOST_MASTER, CAT_HOST_OPT, CAT_WIRE,
+              CAT_CKPT, CAT_PREFETCH, CAT_PIPE)
 
 
 # ----------------------------------------------------------------------
@@ -407,6 +416,16 @@ def oom_hints(payload):
             "bytes scale with prefetch_layers + 1), or set "
             "stage3.release_after_use true if the naive up-front "
             "gather mode is on")
+    if cats.get(CAT_KV) and ledger and \
+            cats[CAT_KV] > 0.3 * ledger:
+        hints.append(
+            "the serving KV-cache page pool holds "
+            f"{cats[CAT_KV] / 2**30:.2f} GiB of {ledger / 2**30:.2f} "
+            "GiB ledgered: lower inference.kv_cache.num_pages (the "
+            "pool is preallocated — every page counts against HBM "
+            "whether or not a request holds it), shrink "
+            "inference.max_slots / max_seq_len, or serve int8 weights "
+            '("inference": {"weight_bits": 8}) to free headroom')
     state = (cats.get(CAT_MASTER, 0) + cats.get(CAT_OPT, 0) +
              cats.get(CAT_GRADS, 0))
     if ledger and state > 0.5 * ledger:

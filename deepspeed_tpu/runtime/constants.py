@@ -468,3 +468,69 @@ STAGE3_RELEASE_AFTER_USE_DEFAULT = True
 STAGE3_GATHER_DTYPE = "gather_dtype"
 STAGE3_GATHER_DTYPE_DEFAULT = None
 STAGE3_GATHER_DTYPE_VALID = (None, "fp32", "bf16", "fp16")
+
+#############################################
+# Inference/serving engine (TPU-native extension): AOT-compiled
+# prefill + single-token decode over a device-resident paged KV cache
+# with continuous batching (deepspeed_tpu/inference/), configured
+# under a top-level "inference" block:
+#   {"inference": {"max_slots": 8, "prefill_chunk": 64,
+#                  "sync_every": 8, "max_new_tokens": 128,
+#                  "max_seq_len": null, "eos_token_id": null,
+#                  "top_k_max": 64, "seed": 0,
+#                  "weight_bits": 32, "weight_quant_block": 64,
+#                  "kv_cache": {"num_pages": 256, "page_size": 16}}}
+# max_slots: concurrent decode request slots — the decode program's
+#   static batch dimension (iteration-level continuous batching admits
+#   queued requests into slots that free up).
+# prefill_chunk: prompt tokens processed per prefill program call;
+#   long prompts run chunk-by-chunk INTERLEAVED with decode so they
+#   never stall the decode batch.
+# sync_every: decode iterations dispatched between serving fences (the
+#   one device_get per fence; the async_dispatch steps_per_sync
+#   convention applied to serving).
+# max_new_tokens: per-request generation cap AND the device output
+#   buffer width (requests may ask for less, never more).
+# max_seq_len: prompt + generated upper bound (null = the model's
+#   n_positions, clamped to kv_cache capacity).
+# eos_token_id: default end-of-sequence id finishing a request early
+#   (null = generate until max_new_tokens; per-request override).
+# top_k_max: static top-k sampling cap compiled into the decode
+#   program (per-request top_k <= top_k_max).
+# seed: base PRNG seed for device-side sampling.
+# weight_bits: 32 = serve the params as given; 8 = int8 weight-only
+#   quantization at load (per-block-scale, the offload_wire block
+#   machinery) with a dequant-in-matmul epilogue.
+# weight_quant_block: quantization block along the contraction dim.
+# kv_cache.num_pages: physical pages in the preallocated device pool
+#   (page 0 is a scratch page for masked writes; num_pages - 1 are
+#   allocatable). The pool is a `kv_cache` memory-ledger category.
+# kv_cache.page_size: tokens per page.
+#############################################
+INFERENCE = "inference"
+INFERENCE_MAX_SLOTS = "max_slots"
+INFERENCE_MAX_SLOTS_DEFAULT = 8
+INFERENCE_PREFILL_CHUNK = "prefill_chunk"
+INFERENCE_PREFILL_CHUNK_DEFAULT = 64
+INFERENCE_SYNC_EVERY = "sync_every"
+INFERENCE_SYNC_EVERY_DEFAULT = 8
+INFERENCE_MAX_NEW_TOKENS = "max_new_tokens"
+INFERENCE_MAX_NEW_TOKENS_DEFAULT = 128
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = None
+INFERENCE_EOS_TOKEN_ID = "eos_token_id"
+INFERENCE_EOS_TOKEN_ID_DEFAULT = None
+INFERENCE_TOP_K_MAX = "top_k_max"
+INFERENCE_TOP_K_MAX_DEFAULT = 64
+INFERENCE_SEED = "seed"
+INFERENCE_SEED_DEFAULT = 0
+INFERENCE_WEIGHT_BITS = "weight_bits"
+INFERENCE_WEIGHT_BITS_DEFAULT = 32
+INFERENCE_WEIGHT_BITS_VALID = (8, 32)
+INFERENCE_WEIGHT_QUANT_BLOCK = "weight_quant_block"
+INFERENCE_WEIGHT_QUANT_BLOCK_DEFAULT = 64
+INFERENCE_KV_CACHE = "kv_cache"
+INFERENCE_KV_NUM_PAGES = "num_pages"
+INFERENCE_KV_NUM_PAGES_DEFAULT = 256
+INFERENCE_KV_PAGE_SIZE = "page_size"
+INFERENCE_KV_PAGE_SIZE_DEFAULT = 16

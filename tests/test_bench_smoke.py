@@ -37,6 +37,36 @@ def test_bench_list_prints_legs():
     assert "monitor_overhead" in legs and "numerics_overhead" in legs
     assert "memory_ledger" in legs and "zero3_overlap" in legs
     assert "elastic_recovery" in legs
+    assert "serving_throughput" in legs
+
+
+def test_bench_list_and_only_error_agree_with_the_registry():
+    """`--list` and the unknown-`--only` error message must both be
+    generated from BENCH_LEGS — the audit (ISSUE 12 satellite) that a
+    new leg cannot silently drop out of either surface. Asserted as
+    set equality between the two outputs AND against the registry
+    itself, so the next added leg is covered automatically."""
+    list_proc = _bench_proc("--list")
+    assert list_proc.returncode == 0, list_proc.stderr[-500:]
+    listed = set(list_proc.stdout.split())
+
+    err_proc = _bench_proc("--only", "definitely_not_a_leg")
+    assert err_proc.returncode != 0
+    # the error names every valid leg: "valid legs: a, b, c"
+    tail = err_proc.stderr.split("valid legs:", 1)
+    assert len(tail) == 2, err_proc.stderr[-500:]
+    named = {t.strip() for t in tail[1].strip().split(",")}
+    assert named == listed, (named ^ listed)
+
+    import runpy
+    mod = runpy.run_path(os.path.join(REPO, "bench.py"))
+    registry = set(mod["BENCH_LEGS"])
+    assert listed == registry, (listed ^ registry)
+    # the legs added since PR 5 (the audited five + the serving leg)
+    for leg in ("fused_hot_loop", "pipe_interleave",
+                "numerics_overhead", "memory_ledger", "zero3_overlap",
+                "elastic_recovery", "serving_throughput"):
+        assert leg in registry, leg
 
 
 def test_bench_only_fused_hot_loop_leg():
@@ -259,6 +289,49 @@ def test_bench_only_elastic_recovery_leg():
     # scale-up restored the original device count at a boundary
     assert result["grow"]["world_restored"] == 8
     assert result["grow"]["at_checkpoint_boundary"] is True
+
+
+def test_bench_only_serving_throughput_leg():
+    """The serving A/B (ISSUE 12) via `--only` on the 8-device virtual
+    mesh: continuous batching must clear the >= 2x acceptance bar over
+    request-at-a-time serving under the same Poisson arrival stream
+    (the advantage is structural — 8 slots decode for the price of
+    one step — so unlike raw step-time ratios it holds on a loaded
+    shared box), decode-logits parity vs the training forward is
+    asserted BIT-exact inside the leg (fp32), the `kv_cache` ledger
+    category must equal independent page-pool arithmetic exactly, and
+    the int8 weight-quant A/B records its pinned tolerance."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=8"])
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import runpy; runpy.run_path("
+            f"{os.path.join(REPO, 'bench.py')!r}, run_name='__main__')")
+    proc = subprocess.run(
+        [sys.executable, "-c", code, "--only", "serving_throughput"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "serving_throughput"
+    result = d["result"]
+    assert "error" not in result, result
+    # the correctness contracts are hard asserts
+    assert result["parity_bitexact_fp32"] is True
+    assert result["kv_ledger_exact"] is True
+    assert result["int8_logits_maxdiff"] < 2e-2
+    assert result["int8_greedy_match"] is True
+    # both legs served every request and recorded the latency tails
+    for leg in ("sequential", "continuous"):
+        assert result[leg]["requests"] == result["requests"]
+        assert result[leg]["tokens_per_sec"] > 0
+        assert result[leg]["p99_token_ms"] >= result[leg]["p50_token_ms"]
+    assert result["devices"] == 8
+    assert result["tokens_per_sec_per_chip"] > 0
+    # the acceptance bar: continuous batching >= 2x tokens/s
+    assert result["continuous_vs_sequential_speedup"] >= 2.0, result
 
 
 def test_bench_only_unknown_leg_fails_with_list():

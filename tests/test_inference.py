@@ -1,0 +1,655 @@
+"""Inference/serving engine tests (ISSUE 12).
+
+Covers:
+  * decode-step logits BIT-exact vs the training-path forward on the
+    same prefix (fp32, small-contraction regime) and to float roundoff
+    at larger sizes (the PR-9 precedent: cross-program reduction
+    orders preclude literal bit equality once XLA switches matmul
+    kernels at different static shapes);
+  * paged attention vs a contiguous-cache dense_attention reference;
+  * page alloc/free accounting vs independent byte arithmetic, and
+    the `kv_cache` ledger category == pool bytes invariant (the PR-9
+    ledger window-bound pattern);
+  * the NO-HOST-SYNC guard for a multi-request decode loop: zero
+    `jax.device_get`/`jax.effects_barrier` between serving fences,
+    exactly ONE device_get per fence;
+  * continuous-batching scheduler semantics: admission beyond slot
+    count, chunked-prefill interleaving, EOS/max-tokens eviction,
+    page reuse — with per-request outputs IDENTICAL to isolated
+    single-request runs (cache isolation);
+  * int8 weight-only quantization within pinned tolerance of fp32;
+  * device-side sampling (top_k=1 == greedy; same-seed determinism);
+  * `inference` config-block validation and serving monitor events.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import (InferenceConfig, InferenceConfigError,
+                                     InferenceEngine, PagedKVCache,
+                                     Request, ServingLoop)
+from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, tiny_gpt2_config
+
+
+def _params(model):
+    return model.init(jax.random.PRNGKey(0),
+                      {"input_ids": np.zeros((1, 8), np.int32)})
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tiny_gpt2_config()
+    model = GPT2ForCausalLM(cfg)
+    params = _params(model)
+    engine = InferenceEngine(cfg, params, {"inference": {
+        "max_slots": 4, "prefill_chunk": 16, "sync_every": 4,
+        "max_new_tokens": 32,
+        "kv_cache": {"num_pages": 120, "page_size": 4}}})
+    return cfg, model, params, engine
+
+
+def _train_logits(model, params, tokens):
+    out = model.apply(params, np.asarray(tokens, np.int32)[None, :],
+                      True)
+    return np.asarray(out)[0, -1]
+
+
+# ----------------------------------------------------------------------
+# decode-logits parity vs the training forward
+# ----------------------------------------------------------------------
+def test_decode_logits_bitexact_vs_training_forward(setup):
+    """fp32, total length <= 12: the decode program and the training
+    forward run in the same XLA-CPU kernel regime, so the logits must
+    be LITERALLY bit-identical at every generated position — any math
+    drift between the serving forward and the training forward shows
+    up here as a hard failure."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(1)
+    prompt = r.randint(0, cfg.vocab_size, size=7).astype(np.int32)
+    engine.start_request(0, prompt, max_new=5)
+    cur = list(prompt)
+    for step in range(5):
+        logits = np.asarray(engine.decode_once()[0])
+        ref = _train_logits(model, params, cur)
+        assert np.array_equal(logits, ref), \
+            (step, np.abs(logits - ref).max())
+        cur.append(int(logits.argmax()))
+    engine.reset()
+
+
+def test_decode_logits_roundoff_parity_long(setup):
+    """Longer sequences (chunked prefill, length past XLA-CPU's
+    small-gemm threshold): the same math through differently-shaped
+    programs — parity to float roundoff (observed ~2e-7; pinned at
+    3e-6), greedy tokens identical."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(2)
+    prompt = r.randint(0, cfg.vocab_size, size=37).astype(np.int32)
+    engine.start_request(0, prompt, max_new=20)
+    cur = list(prompt)
+    for _ in range(20):
+        logits = np.asarray(engine.decode_once()[0])
+        ref = _train_logits(model, params, cur)
+        np.testing.assert_allclose(logits, ref, atol=3e-6, rtol=0)
+        assert logits.argmax() == ref.argmax()
+        cur.append(int(logits.argmax()))
+    engine.reset()
+
+
+def test_paged_attention_matches_contiguous_reference():
+    """Unit: paged_attention over a zero-padded page window ==
+    dense_attention over the contiguous cache (bit-exact in the
+    small-kernel regime, float roundoff beyond)."""
+    from deepspeed_tpu.inference.engine import paged_attention
+    from deepspeed_tpu.ops.transformer.flash_attention import \
+        dense_attention
+    r = np.random.RandomState(3)
+    for t, exact in ((10, True), (48, False)):
+        q = r.randn(1, t, 4, 16).astype(np.float32)
+        k = r.randn(1, t, 4, 16).astype(np.float32)
+        v = r.randn(1, t, 4, 16).astype(np.float32)
+        tmax = 64
+        kc = np.zeros((1, tmax, 4, 16), np.float32)
+        vc = r.randn(1, tmax, 4, 16).astype(np.float32)  # garbage tail
+        kc[:, :t] = k
+        vc[:, :t] = v
+        ref = np.asarray(jax.jit(
+            lambda q, k, v: dense_attention(q, k, v, causal=True))(
+                q, k, v))
+        got = np.asarray(jax.jit(paged_attention)(
+            q, jnp.asarray(kc), jnp.asarray(vc),
+            np.arange(t, dtype=np.int32)[None, :],
+            np.asarray([t - 1], np.int32)))
+        if exact:
+            assert np.array_equal(ref, got), np.abs(ref - got).max()
+        else:
+            np.testing.assert_allclose(ref, got, atol=2e-6, rtol=0)
+
+
+# ----------------------------------------------------------------------
+# paged cache accounting vs independent byte arithmetic
+# ----------------------------------------------------------------------
+def test_page_alloc_free_accounting_vs_byte_arithmetic():
+    from deepspeed_tpu.monitor.memory import CAT_KV, MemoryLedger
+    ledger = MemoryLedger()
+    cache = PagedKVCache(n_layer=2, n_head=4, head_dim=16,
+                         num_pages=32, page_size=4, max_slots=4,
+                         max_pages_per_slot=8, dtype=np.float32,
+                         ledger=ledger)
+    # independent arithmetic: one page = 2 (K+V) * L * page * H * D * 4B
+    page_bytes = 2 * 2 * 4 * 4 * 16 * 4
+    assert cache.page_bytes == page_bytes
+    assert cache.pool_bytes == 32 * page_bytes
+
+    def kv_total():
+        return ledger.totals()["hbm"].get(CAT_KV, 0)
+
+    # empty cache: the whole pool is 'unallocated' but still resident
+    assert kv_total() == cache.pool_bytes
+
+    cache.admit(0, 13, name="a")           # worst case ceil(13/4)=4 pages
+    assert cache.allocated_pages(0) == 0   # reservation only
+    cache.ensure(0, 6)                     # ceil(6/4)=2 pages assigned
+    assert cache.allocated_pages(0) == 2
+    assert cache.slot_bytes(0) == 2 * page_bytes
+    assert kv_total() == cache.pool_bytes  # invariant: total == pool
+    cache.ensure(0, 13)
+    assert cache.slot_bytes(0) == 4 * page_bytes
+    # per-request ledger entry matches the arithmetic
+    tops = {b["name"]: b["bytes"] for b in ledger.top_buffers(16)
+            if b["category"] == CAT_KV}
+    assert tops["request.s0.a"] == 4 * page_bytes
+
+    # growth past the reservation must refuse, not corrupt
+    with pytest.raises(RuntimeError):
+        cache.ensure(0, 17)
+
+    # admission control: 31 allocatable pages, 4 held + reservations
+    cache.admit(1, 16, name="b")           # reserves 4 more
+    assert cache.free_pages() == 31 - 4
+    # a request needing more than the uncommitted remainder is refused
+    assert not cache.can_admit(4 * (31 - 4 - 4 + 1))
+    assert cache.can_admit(8)
+
+    # free returns every page and closes the ledger entry
+    freed = cache.free(0)
+    assert freed == 4
+    assert cache.free_pages() == 31
+    tops = {b["name"] for b in ledger.top_buffers(16)
+            if b["category"] == CAT_KV}
+    assert "request.s0.a" not in tops
+    assert kv_total() == cache.pool_bytes
+    # the freed pages are reusable immediately
+    cache.ensure(1, 16)
+    assert cache.slot_bytes(1) == 4 * page_bytes
+    cache.free(1)
+    assert cache.free_pages() == 31
+    assert (cache.tables == 0).all()
+
+
+def test_serving_kv_ledger_matches_pool_through_lifecycle(setup):
+    from deepspeed_tpu.monitor.memory import CAT_KV
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(4)
+    prompt = r.randint(0, cfg.vocab_size, size=11).astype(np.int32)
+    engine.start_request(0, prompt, max_new=6)
+    cats = engine.monitor.ledger.totals()["hbm"]
+    assert cats[CAT_KV] == engine.cache.pool_bytes
+    # start_request assigns the worst case up front: ceil((11+6)/4)
+    assert engine.cache.slot_bytes(0) == \
+        -(-(11 + 6) // 4) * engine.cache.page_bytes
+    engine.decode_block(6)
+    engine.fetch_state()
+    engine.reset()
+    assert engine.cache.allocated_bytes() == 0
+    assert engine.monitor.ledger.totals()["hbm"][CAT_KV] == \
+        engine.cache.pool_bytes
+
+
+def test_oom_hint_names_kv_cache_num_pages():
+    from deepspeed_tpu.monitor.memory import oom_hints
+    payload = {"hbm": {"categories": {"kv_cache": 10 * 2**30,
+                                      "params": 2 * 2**30},
+                       "ledger_bytes": 12 * 2**30,
+                       "measured_in_use_per_device": 13 * 2**30,
+                       "residual_bytes": 1 * 2**30}}
+    hints = " ".join(oom_hints(payload))
+    assert "inference.kv_cache.num_pages" in hints
+
+
+# ----------------------------------------------------------------------
+# the no-host-sync guard for the multi-request decode loop
+# ----------------------------------------------------------------------
+class _SyncCounters:
+    """Same instrumentation as test_async_dispatch: count the host-sync
+    entry points (`jax.device_get`, `jax.effects_barrier`)."""
+
+    def __init__(self, monkeypatch):
+        self.device_get = 0
+        self.effects_barrier = 0
+        real_get, real_barrier = jax.device_get, jax.effects_barrier
+
+        def counting_get(x):
+            self.device_get += 1
+            return real_get(x)
+
+        def counting_barrier():
+            self.effects_barrier += 1
+            return real_barrier()
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+        monkeypatch.setattr(jax, "effects_barrier", counting_barrier)
+
+
+def test_multi_request_decode_loop_has_zero_host_syncs(setup,
+                                                       monkeypatch):
+    """The serving acceptance guard: with THREE live requests, decode
+    blocks dispatched between fences perform ZERO host<->device syncs,
+    and the serving fence costs exactly ONE device_get."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(5)
+    for slot in range(3):
+        prompt = r.randint(0, cfg.vocab_size,
+                           size=6 + 3 * slot).astype(np.int32)
+        engine.start_request(slot, prompt, max_new=20)
+    engine.decode_block(4)     # warm the dispatch path
+    counters = _SyncCounters(monkeypatch)
+    for _ in range(3):
+        engine.decode_block(4)
+    assert counters.device_get == 0, \
+        f"decode loop called jax.device_get {counters.device_get}x"
+    assert counters.effects_barrier == 0
+    snap = engine.fetch_state()
+    assert counters.device_get == 1, \
+        "the serving fence must cost exactly ONE device_get"
+    assert snap["n_gen"][:3].min() > 0
+    engine.reset()
+
+
+def test_serving_loop_step_syncs_only_at_fence(setup, monkeypatch):
+    """ServingLoop.step (admit -> prefill -> decode block -> fence)
+    performs exactly one device_get per iteration — the fence."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    loop = ServingLoop(engine)
+    r = np.random.RandomState(6)
+    for i in range(3):
+        loop.submit(Request(rid=i, tokens=r.randint(
+            0, cfg.vocab_size, size=9), max_new_tokens=12))
+    import time
+    loop._t0 = time.monotonic()
+    loop._last_fence_t = loop._now()
+    loop.step()    # compile/admission settle
+    counters = _SyncCounters(monkeypatch)
+    n = 0
+    while (loop.queue or loop.live or loop.prefilling) and n < 50:
+        loop.step()
+        n += 1
+    assert n > 0
+    assert counters.device_get == n, (counters.device_get, n)
+    assert counters.effects_barrier == 0
+    engine.reset()
+
+
+# ----------------------------------------------------------------------
+# continuous batching semantics
+# ----------------------------------------------------------------------
+def test_continuous_batch_matches_isolated_runs(setup):
+    """10 requests through 4 slots (forced queueing + page reuse):
+    every request's greedy output must be IDENTICAL to serving it
+    alone — cache pages are isolated per request and recycling a page
+    never leaks another request's KV."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(7)
+    reqs = [(i, r.randint(0, cfg.vocab_size,
+                          size=int(r.randint(3, 30))).astype(np.int32),
+             int(r.randint(4, 12))) for i in range(10)]
+    loop = ServingLoop(engine)
+    res = loop.serve([Request(rid=i, tokens=t.copy(), max_new_tokens=m)
+                      for i, t, m in reqs])
+    assert len(res) == 10
+    batched = {q.rid: q.out_tokens.tolist() for q in res}
+    engine.reset()
+    for i, t, m in reqs:
+        alone = ServingLoop(engine).serve(
+            [Request(rid=i, tokens=t.copy(), max_new_tokens=m)])[0]
+        assert alone.out_tokens.tolist() == batched[i], i
+    # everything came back: pages all free, ledger back to pool-only
+    assert engine.cache.free_pages() == engine.cache.num_pages - 1
+
+
+def test_chunked_prefill_interleaves_with_decode(setup):
+    """A long prompt (3 chunks) admitted while another request decodes:
+    the decoding request keeps generating between the chunks (its
+    token count advances before the long prompt goes live), and the
+    long request's output still matches its isolated run."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(8)
+    short = r.randint(0, cfg.vocab_size, size=4).astype(np.int32)
+    long_p = r.randint(0, cfg.vocab_size, size=40).astype(np.int32)
+    loop = ServingLoop(engine)
+    loop.submit(Request(rid="short", tokens=short, max_new_tokens=24))
+    loop.submit(Request(rid="long", tokens=long_p, max_new_tokens=6))
+    import time
+    loop._t0 = time.monotonic()
+    loop._last_fence_t = loop._now()
+    # drive manually: after the first step the short request is live;
+    # the long one is still prefilling (40 tokens / 16-chunk > 1 turn)
+    loop.step()
+    assert "long" in {q.rid for q, _ in loop.prefilling.values()} or \
+        any(q.rid == "long" for q in loop.live.values())
+    interleaved = False
+    for _ in range(60):
+        if not (loop.queue or loop.live or loop.prefilling):
+            break
+        was_prefilling = any(q.rid == "long"
+                             for q, _ in loop.prefilling.values())
+        short_live = any(q.rid == "short" for q in loop.live.values())
+        if was_prefilling and short_live and \
+                int(loop._last_n_gen[list(loop.live)[0]]) > 0:
+            interleaved = True
+        loop.step()
+    assert interleaved, \
+        "the short request never decoded while the long one prefilled"
+    out = {q.rid: q.out_tokens.tolist() for q in loop.results}
+    engine.reset()
+    ref = ServingLoop(engine).serve(
+        [Request(rid="long", tokens=long_p.copy(), max_new_tokens=6)])[0]
+    assert out["long"] == ref.out_tokens.tolist()
+    engine.reset()
+
+
+def test_out_of_order_arrivals_do_not_block_ready_requests(setup):
+    """A not-yet-arrived request at the queue head must not block an
+    already-arrived one behind it (submission order need not be
+    arrival order)."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(17)
+    loop = ServingLoop(engine)
+    loop.submit(Request(rid="late", tokens=r.randint(
+        0, cfg.vocab_size, size=5), max_new_tokens=4,
+        arrival_time=30.0))
+    loop.submit(Request(rid="now", tokens=r.randint(
+        0, cfg.vocab_size, size=5), max_new_tokens=4,
+        arrival_time=0.0))
+    import time
+    loop._t0 = time.monotonic()
+    loop._last_fence_t = loop._now()
+    for _ in range(20):
+        loop.step()
+        if loop.results:
+            break
+    assert loop.results and loop.results[0].rid == "now", \
+        "the ready request starved behind a future arrival"
+    # the future request is still queued, untouched
+    assert len(loop.queue) == 1 and loop.queue[0].rid == "late"
+    engine.reset()
+
+
+def test_eos_eviction(setup):
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(9)
+    prompt = r.randint(0, cfg.vocab_size, size=8).astype(np.int32)
+    # learn what greedy generates, then make the FIRST token the EOS
+    probe = ServingLoop(engine).serve(
+        [Request(rid="p", tokens=prompt.copy(), max_new_tokens=4)])[0]
+    assert probe.finish_reason == "max_tokens"
+    eos = int(probe.out_tokens[0])
+    engine.reset()
+    got = ServingLoop(engine).serve(
+        [Request(rid="e", tokens=prompt.copy(), max_new_tokens=10,
+                 eos_token_id=eos)])[0]
+    assert got.finish_reason == "eos"
+    # the EOS token is recorded, and generation stopped right there
+    assert got.out_tokens.tolist() == [eos]
+    engine.reset()
+
+
+def test_max_tokens_eviction_and_counts(setup):
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(10)
+    res = ServingLoop(engine).serve(
+        [Request(rid=i, tokens=r.randint(0, cfg.vocab_size, size=5),
+                 max_new_tokens=7) for i in range(2)])
+    for q in res:
+        assert q.finish_reason == "max_tokens"
+        assert len(q.out_tokens) == 7
+        assert q.finished_at is not None and q.admitted_at is not None
+    engine.reset()
+
+
+# ----------------------------------------------------------------------
+# sampling
+# ----------------------------------------------------------------------
+def test_topk1_sampling_equals_greedy(setup):
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(11)
+    prompt = r.randint(0, cfg.vocab_size, size=9).astype(np.int32)
+    greedy = ServingLoop(engine).serve(
+        [Request(rid="g", tokens=prompt.copy(), max_new_tokens=8)])[0]
+    engine.reset()
+    topk1 = ServingLoop(engine).serve(
+        [Request(rid="t", tokens=prompt.copy(), max_new_tokens=8,
+                 temperature=1.0, top_k=1)])[0]
+    assert topk1.out_tokens.tolist() == greedy.out_tokens.tolist()
+    engine.reset()
+
+
+def test_sampling_same_seed_is_deterministic(setup):
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(12)
+    prompt = r.randint(0, cfg.vocab_size, size=9).astype(np.int32)
+
+    def run():
+        engine.reset()
+        return ServingLoop(engine).serve(
+            [Request(rid="s", tokens=prompt.copy(), max_new_tokens=8,
+                     temperature=0.8, top_k=16)])[0].out_tokens.tolist()
+
+    a = run()
+    # the decode program's step counter keeps advancing across resets?
+    # no: reset() rebuilds state with step=0, so the stream replays
+    b = run()
+    assert a == b
+    assert all(0 <= t < cfg.vocab_size for t in a)
+    engine.reset()
+
+
+# ----------------------------------------------------------------------
+# int8 weight-only quantization
+# ----------------------------------------------------------------------
+def test_int8_weight_quant_within_pinned_tolerance(setup):
+    """The serving quant A/B (the offload-wire parity convention):
+    int8 per-block-scale weights must track the fp32 logits within
+    the pinned tolerance on the tiny model (measured ~2e-3) and agree
+    on the greedy token."""
+    cfg, model, params, engine = setup
+    engine.reset()
+    e8 = InferenceEngine(cfg, params, {"inference": {
+        "max_slots": 4, "prefill_chunk": 16, "sync_every": 4,
+        "max_new_tokens": 32, "weight_bits": 8,
+        "weight_quant_block": 32,
+        "kv_cache": {"num_pages": 120, "page_size": 4}}})
+    r = np.random.RandomState(13)
+    prompt = r.randint(0, cfg.vocab_size, size=12).astype(np.int32)
+    engine.start_request(0, prompt, max_new=6)
+    e8.start_request(0, prompt, max_new=6)
+    for _ in range(3):
+        l32 = np.asarray(engine.decode_once()[0])
+        l8 = np.asarray(e8.decode_once()[0])
+        assert np.abs(l32 - l8).max() < 2e-2, np.abs(l32 - l8).max()
+        assert l32.argmax() == l8.argmax()
+    engine.reset()
+
+
+def test_int8_quant_roundtrip_unit():
+    from deepspeed_tpu.inference.quant import (int8_matmul,
+                                               quantize_kernel_int8)
+    r = np.random.RandomState(14)
+    w = (r.randn(48, 24) * 0.05).astype(np.float32)
+    q, s = quantize_kernel_int8(w, block=16)
+    assert q.dtype == np.int8 and q.shape == w.shape
+    assert s.shape == (3, 24)
+    # dequantised weights within one quantisation step per block
+    deq = (q.reshape(3, 16, 24).astype(np.float32) *
+           s[:, None, :]).reshape(48, 24)
+    assert np.abs(deq - w).max() <= (s.max() / 2) + 1e-8
+    x = r.randn(5, 48).astype(np.float32)
+    y = np.asarray(int8_matmul(jnp.asarray(x), jnp.asarray(q),
+                               jnp.asarray(s), 16, jnp.float32))
+    np.testing.assert_allclose(y, x @ deq, atol=1e-4, rtol=1e-4)
+
+
+# ----------------------------------------------------------------------
+# config validation + submit validation
+# ----------------------------------------------------------------------
+def test_inference_config_validation():
+    assert InferenceConfig({}).max_slots == 8
+    assert InferenceConfig(None).kv_num_pages == 256
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": "nope"})
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": {"max_slots": 0}})
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": {"weight_bits": 4}})
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": {"kv_cache": {"num_pages": 1}}})
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": {"kv_cache": []}})
+    with pytest.raises(InferenceConfigError):
+        InferenceConfig({"inference": {"sync_every": -1}})
+
+
+def test_submit_validation(setup):
+    cfg, model, params, engine = setup
+    engine.reset()
+    loop = ServingLoop(engine)
+    with pytest.raises(ValueError, match="empty prompt"):
+        loop.submit(Request(rid="x", tokens=np.zeros((0,), np.int32)))
+    with pytest.raises(ValueError, match="max_seq_len"):
+        loop.submit(Request(rid="x", tokens=np.zeros((120,), np.int32),
+                            max_new_tokens=30))
+    with pytest.raises(ValueError, match="buffer width"):
+        loop.submit(Request(rid="x", tokens=np.zeros((4,), np.int32),
+                            max_new_tokens=33))
+    with pytest.raises(ValueError, match="top_k_max"):
+        loop.submit(Request(rid="x", tokens=np.zeros((4,), np.int32),
+                            max_new_tokens=4, temperature=1.0,
+                            top_k=500))
+    with pytest.raises(ValueError, match="top_k_max"):
+        engine.start_request(0, np.zeros((4,), np.int32), max_new=4,
+                             top_k=500)
+    with pytest.raises(ValueError, match="ring width"):
+        engine.start_request(0, np.zeros((4,), np.int32), max_new=33)
+    # a request that can NEVER fit the page pool is rejected at
+    # submit, not left to starve the queue behind it
+    small = InferenceEngine(tiny_gpt2_config(), _params(model),
+                            {"inference": {
+                                "max_slots": 2, "prefill_chunk": 8,
+                                "sync_every": 2, "max_new_tokens": 16,
+                                "kv_cache": {"num_pages": 4,
+                                             "page_size": 4}}})
+    with pytest.raises(ValueError, match="usable pages"):
+        ServingLoop(small).submit(
+            Request(rid="big", tokens=np.zeros((10,), np.int32),
+                    max_new_tokens=10))
+
+
+def test_duplicate_request_ids_keep_ledger_exact(setup):
+    """Two live requests sharing one rid must not collide on the
+    ledger key: freeing the first leaves the second's entry intact
+    and the kv_cache category total stays == pool bytes."""
+    from deepspeed_tpu.monitor.memory import CAT_KV
+    cfg, model, params, engine = setup
+    engine.reset()
+    r = np.random.RandomState(16)
+    engine.cache.admit(0, 8, name="user-42")
+    engine.cache.admit(1, 8, name="user-42")
+    engine.cache.ensure(0, 8)
+    engine.cache.ensure(1, 8)
+    led = engine.monitor.ledger
+    assert led.totals()["hbm"][CAT_KV] == engine.cache.pool_bytes
+    engine.cache.free(0)
+    # slot 1's entry survives slot 0's free
+    tops = {b["name"] for b in led.top_buffers(32)
+            if b["category"] == CAT_KV}
+    assert "request.s1.user-42" in tops
+    assert led.totals()["hbm"][CAT_KV] == engine.cache.pool_bytes
+    engine.cache.free(1)
+    engine.reset()
+
+
+def test_config_error_names_dotted_key():
+    for bad in ({"weight_bits": "eight"}, {"seed": "abc"},
+                {"eos_token_id": "x"}):
+        with pytest.raises(InferenceConfigError, match="inference\\."):
+            InferenceConfig({"inference": bad})
+
+
+# ----------------------------------------------------------------------
+# serving monitor events
+# ----------------------------------------------------------------------
+def test_serving_monitor_events_schema(tmp_path):
+    cfg = tiny_gpt2_config()
+    model = GPT2ForCausalLM(cfg)
+    params = _params(model)
+    engine = InferenceEngine(cfg, params, {
+        "inference": {"max_slots": 2, "prefill_chunk": 8,
+                      "sync_every": 4, "max_new_tokens": 16,
+                      "kv_cache": {"num_pages": 48, "page_size": 4}},
+        "monitor": {"enabled": True, "sinks": ["jsonl"],
+                    "output_path": str(tmp_path)}})
+    r = np.random.RandomState(15)
+    ServingLoop(engine).serve(
+        [Request(rid=f"r{i}", tokens=r.randint(0, cfg.vocab_size,
+                                               size=6 + i),
+                 max_new_tokens=5) for i in range(3)])
+    engine.monitor.close()
+    events = []
+    for root, _, files in os.walk(tmp_path):
+        for f in files:
+            if f.endswith(".jsonl"):
+                with open(os.path.join(root, f)) as fh:
+                    events += [json.loads(line) for line in fh]
+    kinds = {}
+    for e in events:
+        kinds.setdefault(e["kind"], []).append(e)
+    assert len(kinds.get("request_admitted", [])) == 3
+    assert len(kinds.get("request_finished", [])) == 3
+    assert kinds.get("decode_batch")
+    assert kinds.get("memory"), "memory events must ride serving fences"
+    adm = kinds["request_admitted"][0]
+    for key in ("request_id", "slot", "prompt_tokens", "max_new_tokens",
+                "queue_depth", "queued_ms"):
+        assert key in adm, key
+    fin = kinds["request_finished"][0]
+    for key in ("request_id", "slot", "reason", "prompt_tokens",
+                "new_tokens", "queued_ms", "ttft_ms", "wall_ms",
+                "tokens_per_sec"):
+        assert key in fin, key
+    dec = kinds["decode_batch"][0]
+    for key in ("iterations", "active_slots", "prefilling_slots",
+                "queue_depth", "window_tokens", "tokens_per_sec",
+                "kv_pages_in_use", "kv_pages_free"):
+        assert key in dec, key
+    # the memory event's kv_cache category equals the pool bytes
+    mem = kinds["memory"][-1]
+    assert mem["hbm"]["categories"]["kv_cache"] == \
+        engine.cache.pool_bytes
