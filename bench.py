@@ -145,12 +145,21 @@ def _run_engine(model, params_box, ds_config, make_batch, steps, warmup,
         except Exception:
             probe_run = None   # a dead probe must not kill the headline
     probe_samples = []
+    # Each probe point takes _PROBE_REPS repetitions and the reported
+    # probe is the MEDIAN over every repetition of every interleaved
+    # point (BENCH_r04's `peak_probe_warning` flake: a single
+    # contended probe window read 65 TF against 86 TF achieved —
+    # "probe < achieved" — purely from one bad sample; the median
+    # over N reps is robust to a minority of contended windows, and
+    # main() only warns when the MEDIAN is below achieved).
+    PROBE_REPS = 3
 
     def take_probe():
         if probe_run is None:
             return
         try:
-            probe_samples.append(probe_run())
+            for _ in range(PROBE_REPS):
+                probe_samples.append(probe_run())
         except Exception:
             pass
 
@@ -168,8 +177,10 @@ def _run_engine(model, params_box, ds_config, make_batch, steps, warmup,
         _sync(loss)
         best = min(best, time.perf_counter() - t0)
     take_probe()
-    # median across interleaved windows: the latency-difference trick
-    # jitters symmetrically (a max would systematically over-read)
+    # median across all reps of all interleaved points: the
+    # latency-difference trick jitters symmetrically (a max would
+    # systematically over-read) and single contended windows are
+    # outvoted (the BENCH_r04 peak_probe_warning fix)
     probe_med = float(np.median(probe_samples)) if probe_samples else 0.0
     return best, engine, probe_med
 
@@ -2418,6 +2429,296 @@ def bench_serving_throughput():
 # Named bench legs (single source for both `--only` and the full-suite
 # extras; each returns one JSON-able dict). Order matters: the full
 # suite runs the TPU legs in this order, then the memory plan.
+def bench_quantized_matmul():
+    """Quantized-compute GEMM A/B (ISSUE 13): the int8 epilogue
+    family — per-(K-block, N-column) weight scales + per-row
+    activation scales, dequant fused into the GEMM epilogue
+    (ops/transformer/quantized_matmul.py) — vs the plain bf16 GEMM at
+    a flagship-shaped projection, PLUS a 10-step tiny-GPT-2 engine
+    A/B with `quantized_compute` on vs off.  Parity is pinned
+    in-leg (hard asserts): GEMM output within the int8 contract of
+    the f32 reference, engine loss trajectory within bounds of the
+    unquantized run.  On CPU the quantized leg runs the XLA fallback
+    (identical quantization numerics; the measured win is the
+    fallback's f32 GEMM route vs XLA-CPU's slow emulated-bf16 GEMM);
+    on real TPU the Pallas kernel's int8 MXU contraction is the
+    2x-peak path.  Timing is paired order-alternating
+    median-of-ratios with adaptive extension (the numerics_overhead
+    discipline): this shared box swings single GEMM calls ~1.5x at
+    seconds scale, so `int8_speedup` is a recorded contract flag
+    (int8_faster), not a hard assert — the parity bounds ARE hard
+    asserts (they are deterministic)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.quantized_matmul import (
+        quantized_dense, DEFAULT_QUANT_BLOCK)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    m, k, n = (8192, 1600, 6400) if on_tpu else (2048, 1024, 4096)
+    block = DEFAULT_QUANT_BLOCK
+    rng = np.random.default_rng(0)
+    x32 = jnp.asarray(rng.standard_normal((m, k)), jnp.float32)
+    w32 = jnp.asarray(rng.standard_normal((k, n)), jnp.float32)
+    xb, wb = x32.astype(jnp.bfloat16), w32.astype(jnp.bfloat16)
+
+    from deepspeed_tpu.ops.transformer.quantized_matmul import (
+        quantize_kernel_int8, quantized_matmul)
+
+    mm_bf16 = jax.jit(lambda x, w: x @ w)
+    # the epilogue family's core GEMM: weights quantized ONCE (the
+    # steady state — serving quantizes at load; training amortizes
+    # the re-quantization over the step's microbatch GEMM uses),
+    # activations quantized per call, dequant in the epilogue
+    vdt = jnp.int8 if on_tpu else jnp.float32
+    wq, sw = jax.jit(lambda w: quantize_kernel_int8(
+        w, block, values_dtype=vdt))(wb)
+    mm_q8 = jax.jit(lambda x, wq, sw: quantized_matmul(
+        x, wq, sw, block=block, out_dtype=jnp.bfloat16))
+    # the dynamic form: weights re-quantized INSIDE the call (what
+    # quantized_dense pays per trace use when nothing amortizes)
+    mm_q8_dyn = jax.jit(lambda x, w: quantized_dense(
+        x, w, block=block, out_dtype=jnp.bfloat16))
+
+    # parity FIRST (also warms the compiles): int8 contract vs the
+    # f32 reference — per-row x scales + per-(block, col) w scales
+    # bound the relative error at ~1% for gaussian operands
+    ref = np.asarray(x32 @ w32)
+    got = np.asarray(mm_q8(xb, wq, sw)).astype(np.float32)
+    rel = float(np.abs(got - ref).max() / np.abs(ref).max())
+    assert rel <= 0.05, f"quantized GEMM parity broke: rel {rel}"
+    got_dyn = np.asarray(mm_q8_dyn(xb, wb)).astype(np.float32)
+    rel_dyn = float(np.abs(got_dyn - ref).max() / np.abs(ref).max())
+    assert rel_dyn <= 0.05, \
+        f"dynamic quantized GEMM parity broke: rel {rel_dyn}"
+    _sync(mm_bf16(xb, wb)[0, 0].astype(jnp.float32))
+
+    # paired order-alternating windows, median of per-pair ratios (the
+    # numerics_overhead discipline): machine load on this shared box
+    # swings both arms 1.5x at seconds scale, so a per-PAIR ratio
+    # (both arms inside one ~100 ms window, order alternating to
+    # cancel drift-within-pair) is the stable statistic
+    inner = 2 if on_tpu else 3
+
+    def window(fn, *args):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        return (time.perf_counter() - t0) / inner
+
+    window(mm_bf16, xb, wb)               # warm the timing paths
+    window(mm_q8, xb, wq, sw)
+    window(mm_q8_dyn, xb, wb)
+    ratios, ratios_dyn, t_b, t_q = [], [], [], []
+
+    def run_pairs(n):
+        for i in range(n):
+            if i % 2 == 0:
+                tb, tq = window(mm_bf16, xb, wb), \
+                    window(mm_q8, xb, wq, sw)
+            else:
+                tq, tb = window(mm_q8, xb, wq, sw), \
+                    window(mm_bf16, xb, wb)
+            td = window(mm_q8_dyn, xb, wb)
+            ratios.append(tb / tq)
+            ratios_dyn.append(tb / td)
+            t_b.append(tb)
+            t_q.append(tq)
+
+    run_pairs(10)
+    # adaptive extension (the numerics_overhead precedent): this
+    # box's shared-CPU noise swings single GEMM calls ~1.5x AND the
+    # host intermittently throttles to a state where every GEMM dtype
+    # runs at the same (slow) rate — when the median lands in the
+    # ambiguous band around the 1.15 contract line, extend the sample
+    # instead of publishing a coin flip
+    if 0.8 <= float(np.median(ratios)) <= 1.3:
+        run_pairs(10)
+    speedup = float(np.median(ratios))
+    speedup_dyn = float(np.median(ratios_dyn))
+    best = {"bf16": min(t_b), "q8": min(t_q)}
+    # box-state diagnostic: in the healthy state XLA-CPU's f32 GEMM
+    # runs ~4x the bf16 one (the margin the fallback rides); under
+    # host throttle both flatten to the same rate and the recorded
+    # ratio degrades toward 1.0 regardless of the family's merit
+    mm_f32 = jax.jit(lambda x, w: x @ w)
+    jax.block_until_ready(mm_f32(x32, w32))
+    t0 = time.perf_counter()
+    for _ in range(inner):
+        r = mm_f32(x32, w32)
+    jax.block_until_ready(r)
+    f32_ms = (time.perf_counter() - t0) / inner * 1e3
+
+    # engine A/B: same tiny GPT-2, same data, quantized_compute on
+    # vs off — the training-hot-path weave the config block drives
+    from deepspeed_tpu import initialize
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, \
+        tiny_gpt2_config
+    ids = np.random.default_rng(1).integers(
+        0, 256, (10, 1, 4, 64)).astype(np.int32)
+
+    def run(quant):
+        cfg = tiny_gpt2_config(n_positions=64)
+        model = GPT2ForCausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            {"input_ids": ids[0, 0]})
+        ds = {"train_micro_batch_size_per_gpu": 4,
+              "gradient_accumulation_steps": 1,
+              "steps_per_print": 1000,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}}
+        if quant:
+            ds["quantized_compute"] = {"enabled": True, "mode": "on",
+                                       "block": block}
+        engine, _, _, _ = initialize(model=model,
+                                     model_parameters=params,
+                                     config=ds)
+        losses = []
+        for i in range(10):
+            loss = engine.train_batch(batch={"input_ids": ids[i]})
+            losses.append(float(jax.device_get(loss)))
+        return losses
+
+    l_base = run(False)
+    l_quant = run(True)
+    max_dev = max(abs(a - b) for a, b in zip(l_base, l_quant))
+    # loss parity bound: int8 forward error perturbs the trajectory
+    # but must track the fp32 run closely on this tiny model
+    assert max_dev <= 0.2, \
+        f"quantized engine trajectory diverged: {max_dev}"
+    return {
+        "shape": f"M{m} K{k} N{n} block{block}"
+                 + ("" if on_tpu else " (xla-fallback int8 family)"),
+        "bf16_gemm_ms": round(best["bf16"] * 1e3, 2),
+        "quantized_gemm_ms": round(best["q8"] * 1e3, 2),
+        "f32_gemm_ms": round(f32_ms, 2),
+        "int8_speedup": round(speedup, 3),
+        "int8_faster": bool(speedup >= 1.15),
+        "windows_measured": len(ratios),
+        "int8_dynamic_requant_speedup": round(speedup_dyn, 3),
+        "gemm_rel_err_vs_f32": round(rel, 5),
+        "gemm_rel_err_dynamic": round(rel_dyn, 5),
+        "engine_loss_base_final": round(l_base[-1], 5),
+        "engine_loss_quant_final": round(l_quant[-1], 5),
+        "engine_loss_max_abs_dev": round(max_dev, 5),
+        "parity_ok": True,     # the asserts above ARE the pin
+    }
+
+
+def bench_autotune_flash():
+    """Pallas block-size autotuner on the flash forward kernel
+    (ISSUE 13): search (block_q, block_k) candidates at a
+    representative shape with the interleaved best-of-N timing
+    discipline, persist the winning table (versioned JSON +
+    kernel-source hash), prove the applied shapes are >= 1.0x vs the
+    hand-picked defaults (never-slower is enforced by construction:
+    the default is a candidate and the winner must beat it), then
+    RELOAD the table in a fresh subprocess and assert the traced
+    entry point transparently picks the winner up (the
+    process-restart half of the contract)."""
+    import subprocess
+    import sys
+    import tempfile
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops import autotune
+    from deepspeed_tpu.ops.transformer.flash_attention import (
+        flash_attention, _resolve_head_packing)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # t=1024 keeps the hand-picked default (1024/1024, unclamped) a
+    # genuinely distinct candidate from the smaller tiles
+    t, d, h = (1024, 64, 8) if on_tpu else (1024, 64, 1)
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, t, h, d)),
+                    jnp.bfloat16 if on_tpu else jnp.float32)
+    # tune the SAME kernel variant real traces run here: d=64 under
+    # head_packing "auto" packs on real TPU, stays unpacked in the
+    # CPU interpreter — the lookup key must match or traces miss
+    packed = _resolve_head_packing("auto", d, not on_tpu)
+    kernel = "flash_fwd_packed" if packed else "flash_fwd"
+
+    table = os.path.join(tempfile.mkdtemp(prefix="ds_autotune_"),
+                         "autotune_table.json")
+    autotune.reset()
+    autotune.configure(table_path=table)
+    try:
+        def build(params):
+            bq, bk = params["block_q"], params["block_k"]
+            fn = jax.jit(lambda q: flash_attention(
+                q, q, q, causal=True, block_q=bq, block_k=bk))
+            return lambda: jax.block_until_ready(fn(q))
+
+        default = {"block_q": 1024, "block_k": 1024}  # _DEFAULT_BLOCK
+        candidates = [c for c in autotune.flash_block_candidates(t)
+                      if c["block_q"] >= 256 and c["block_k"] >= 256]
+        shape_class = autotune.flash_shape_class(t, d, True, packed)
+        result = autotune.search(
+            kernel, shape_class, q.dtype, candidates, default,
+            build=build, warmup=1, reps=3)
+        assert result["speedup_vs_default"] >= 1.0, result
+
+        # process-restart reload: a fresh interpreter (inheriting
+        # THIS backend — the entry was recorded under it) must load
+        # the persisted table and steer the traced entry point to
+        # the winner
+        code = f"""
+import os, json
+import importlib
+import jax, numpy as np
+import jax.numpy as jnp
+from deepspeed_tpu.ops import autotune
+fa = importlib.import_module(
+    "deepspeed_tpu.ops.transformer.flash_attention")
+autotune.configure(table_path={table!r})
+tuned = autotune.flash_blocks({t}, {d}, True, {packed!r},
+                              np.dtype({str(q.dtype)!r}))
+assert tuned is not None, "table did not reload across the restart"
+q = jnp.zeros((1, {t}, 1, {d}),
+              jnp.bfloat16 if {on_tpu!r} else jnp.float32)
+args = fa._normalize_flash_args(q, q, q, True, None, None, None,
+                                None)
+print("RESULT:" + json.dumps(
+    {{"tuned": list(tuned), "traced_blocks": [args[2], args[3]]}}))
+"""
+        env = dict(os.environ)
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=300)
+        reload_info = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("RESULT:"):
+                reload_info = json.loads(line[len("RESULT:"):])
+        assert reload_info is not None, (
+            "reload subprocess failed: "
+            f"{(proc.stderr or proc.stdout)[-300:]}")
+        assert reload_info["tuned"] == reload_info["traced_blocks"], \
+            reload_info
+        winner = result["params"]
+        assert reload_info["traced_blocks"] == \
+            [winner["block_q"], winner["block_k"]], \
+            (reload_info, winner)
+        return {
+            "shape": f"t{t} d{d} h{h}"
+                     + ("" if on_tpu else " (interpret-mode kernel)"),
+            "kernel": kernel,
+            "shape_class": shape_class,
+            "default_blocks": [default["block_q"],
+                               default["block_k"]],
+            "winning_blocks": [winner["block_q"],
+                               winner["block_k"]],
+            "default_us": result["default_us"],
+            "best_us": result["best_us"],
+            "speedup_vs_default": result["speedup_vs_default"],
+            "never_slower": bool(result["speedup_vs_default"] >= 1.0),
+            "candidates_tried": result["candidates_tried"],
+            "reloaded_across_restart": True,
+            "table_path": table,
+        }
+    finally:
+        # the leg's throwaway table must not steer later legs of a
+        # full-suite run (reset restores factory state: lookups
+        # enabled, default table path)
+        autotune.reset()
+
+
 BENCH_LEGS = {
     "async_checkpoint": bench_async_checkpoint,
     "async_dispatch": bench_async_dispatch,
@@ -2440,6 +2741,8 @@ BENCH_LEGS = {
     "zero3_overlap": bench_zero3_overlap,
     "elastic_recovery": bench_elastic_recovery,
     "serving_throughput": bench_serving_throughput,
+    "quantized_matmul": bench_quantized_matmul,
+    "autotune_flash": bench_autotune_flash,
 }
 
 
@@ -2521,13 +2824,14 @@ def main():
                 "peak: the step windows themselves ran throttled; "
                 "mfu is a LOWER bound for healthy hardware")
         elif probe_tf < achieved:
-            # still self-contradicting (probe jitter / mild
-            # contention): say so rather than publish an impossible
+            # the MEDIAN of N reps per interleaved point is below
+            # achieved — not a single bad window (those are outvoted
+            # now): say so rather than publish an impossible
             # >100% MFU-vs-measured-peak
             extra["peak_probe_note"] = (
-                "probe < achieved step TFLOPS despite interleaving: "
-                "probe jitter or mild contention; nominal-peak MFU is "
-                "the valid headline")
+                "median probe < achieved step TFLOPS despite "
+                "interleaving and median-of-reps: sustained "
+                "contention; nominal-peak MFU is the valid headline")
         elif _peak_flops(jax.devices()[0]) <= 0:
             pass   # unknown generation: no nominal to clamp against
         else:

@@ -45,6 +45,11 @@ HOT_ENTRYPOINTS = (
     "deepspeed_tpu.ops.transformer.fused_ops:"
     "fused_bias_residual_layernorm",
     "deepspeed_tpu.ops.transformer.fused_ops:fused_bias_gelu",
+    # quantized-compute GEMM family (PR 13): traced inside every step
+    # with quantized_compute on — the autotune lookups they make at
+    # trace time are pure host-side dict reads and must stay that way
+    "deepspeed_tpu.ops.transformer.quantized_matmul:quantized_dense",
+    "deepspeed_tpu.ops.transformer.quantized_matmul:quantized_matmul",
     # serving hot path (PR 12): the two AOT step builders (their inner
     # functions are the compiled per-token programs), the sync-free
     # dispatch helpers, and the serving loop's per-iteration step —
@@ -176,6 +181,9 @@ EVENT_EMITTER_MODULE_PREFIXES = (
     "deepspeed_tpu.runtime.engine",
     "deepspeed_tpu.runtime.checkpoint",
     "deepspeed_tpu.inference",
+    # the kernel autotuner emits autotune_search / autotune_hit
+    # through its attached monitor (ops/autotune.py)
+    "deepspeed_tpu.ops.autotune",
 )
 EVENT_SCHEMA_DOC = "docs/monitoring.md"
 EVENT_SCHEMA_BEGIN = "<!-- ds-lint:event-schema:begin -->"

@@ -132,6 +132,26 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("Pallas fused ops", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.ops.transformer.quantized_matmul import \
+            resolve_quantized_compute
+        active = resolve_quantized_compute("auto")
+        rows.append((
+            "quantized compute",
+            f"{SUCCESS} int8 GEMM epilogue family "
+            f"({'Pallas MXU path' if active else 'XLA fallback'}; "
+            "quantized_compute block; docs/quantized-compute.md)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("quantized compute", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.ops import autotune as _autotune
+        rows.append((
+            "kernel autotuner",
+            f"{SUCCESS} block-size table at "
+            f"{_autotune.table_path()} (autotune block; "
+            "bench.py --only autotune_flash)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("kernel autotuner", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.monitor.trace_export import TraceExporter  # noqa: F401
         rows.append(("trace export",
                      f"{SUCCESS} Perfetto/Chrome trace events "

@@ -1,10 +1,14 @@
-"""int8 weight-only quantization for serving (PR-1's per-block-scale
-machinery applied to resident weights instead of the offload wire).
+"""int8 weight-only quantization for serving — a thin veneer over the
+SHARED quantized-matmul primitive
+(`ops/transformer/quantized_matmul.py`), which owns the scale layout
+and the dequant epilogues for BOTH serving and the training
+quantized-compute family (one layout, one epilogue — they cannot
+drift).
 
 Matmul kernels are quantized ONCE at engine load: each [K, N] kernel
 (or stacked [L, K, N] scan kernel) gets symmetric int8 values with one
 fp32 scale per (block-of-K, output column) — scale = max-abs / 127
-over the block, exactly `quantize_int8_blocks`' contract extended with
+over the block, the PR-1 `quantize_int8_blocks` contract extended with
 a per-output-column axis so a single outlier column cannot poison its
 whole block row. Dequantisation happens in the matmul epilogue:
 
@@ -23,7 +27,13 @@ prompts (the offload-wire A/B convention).
 """
 
 import jax.numpy as jnp
-import numpy as np
+
+# the shared primitive: serving's quantizer and epilogue ARE the
+# training family's — re-exported under the legacy serving names
+from deepspeed_tpu.ops.transformer.quantized_matmul import (  # noqa: F401
+    int8_matmul,
+    quantize_kernel_int8_np as quantize_kernel_int8,
+)
 
 # param-tree leaf-dict key marking a quantized kernel; its presence
 # switches the engine's dense application onto the epilogue path
@@ -32,47 +42,6 @@ KERNEL_SCALE = "kernel_scale"
 # the projection submodules whose kernels quantize (GPT-2 block naming;
 # wte/wpe/ln_* stay full precision)
 QUANT_KERNEL_MODULES = ("c_attn", "c_proj", "c_fc", "mlp_c_proj")
-
-
-def quantize_kernel_int8(w, block):
-    """[.., K, N] fp kernel -> (q int8 [.., K, N], scales fp32
-    [.., nb, N]) with K zero-padded conceptually to nb*block (scales
-    for the pad region fall out of max-abs over the real rows)."""
-    w = np.asarray(w, np.float32)
-    k = w.shape[-2]
-    nb = -(-k // block)
-    pad = nb * block - k
-    if pad:
-        wp = np.concatenate(
-            [w, np.zeros(w.shape[:-2] + (pad, w.shape[-1]), np.float32)],
-            axis=-2)
-    else:
-        wp = w
-    blocks = wp.reshape(wp.shape[:-2] + (nb, block, wp.shape[-1]))
-    s = (np.abs(blocks).max(axis=-2) / 127.0).astype(np.float32)
-    safe = np.where(s > 0, s, 1.0).astype(np.float32)
-    q = np.clip(np.rint(blocks / safe[..., None, :]), -127, 127)
-    q = q.astype(np.int8).reshape(wp.shape)[..., :k, :]
-    return q, s
-
-
-def int8_matmul(x, q, scales, block, out_dtype):
-    """The dequant-in-matmul epilogue: x [.., T, K] @ int8 q [K, N]
-    with per-(block, column) scales [nb, N] -> [.., T, N] in
-    out_dtype. Contraction runs per block in out_dtype with the scale
-    applied to each block's partial sum."""
-    k = x.shape[-1]
-    nb = scales.shape[-2]
-    pad = nb * block - k
-    if pad:
-        x = jnp.concatenate(
-            [x, jnp.zeros(x.shape[:-1] + (pad,), x.dtype)], axis=-1)
-        q = jnp.concatenate(
-            [q, jnp.zeros((pad, q.shape[-1]), q.dtype)], axis=0)
-    xb = x.reshape(x.shape[:-1] + (nb, block)).astype(out_dtype)
-    qb = q.reshape(nb, block, q.shape[-1]).astype(out_dtype)
-    part = jnp.einsum("...bk,bkn->...bn", xb, qb)
-    return (part * scales.astype(out_dtype)).sum(axis=-2)
 
 
 def quantize_param_tree(params, block):

@@ -62,6 +62,23 @@ class GPT2Config:
     # either way. Pairs with remat_policy="save_fused_epilogues" for
     # per-fusion rematerialisation.
     fused_ops: str = "auto"
+    # int8 quantized-compute projections ("off"|"on"|"auto"): the
+    # block's four projection matmuls (c_attn, c_proj, c_fc,
+    # mlp_c_proj) contract int8xint8 on the MXU with per-(K-block,
+    # N-column) weight scales + per-row activation scales dequantized
+    # in the GEMM epilogue (ops/transformer/quantized_matmul.py);
+    # weights re-quantize inside every trace, the backward is
+    # straight-through in the compute dtype. "auto" = real TPU only
+    # (the fused_ops convention — CPU numerics stay bit-identical by
+    # default); "off" is bit-for-bit the unquantized path. The
+    # parameter tree is identical either way. Engine-wired via the
+    # `quantized_compute` config block (configure_quantized_compute).
+    quantized_compute: str = "off"
+    quant_block: int = 128
+    # round the int8 quantization stochastically when the engine
+    # provides a per-step "quant" rng stream (unbiased; defaults to
+    # round-to-nearest without one)
+    quant_stochastic_rounding: bool = False
     # Sequence/context parallelism for long sequences: shard T over a
     # mesh axis and run ring (ppermute KV rotation) or ulysses
     # (all-to-all head swap) attention. Set sp_mesh to the engine mesh
@@ -182,37 +199,118 @@ def _attention(config, q, k, v, dropout_rng, deterministic):
     return checkpoint_name(out, "attn_out")
 
 
+def _quant_dense(features, cfg, name, init_scale=1.0, split=False,
+                 sr_fallback=False):
+    """QuantizedDense with nn.Dense/SplitDense-identical parameters —
+    the quantized-compute twin of `_dense` (checkpoints interchange).
+    sr_fallback=True is the family's backward-compatible bf16
+    fallback: no quantization, stochastically rounded operand casts."""
+    from deepspeed_tpu.ops.transformer.transformer import QuantizedDense
+    return QuantizedDense(
+        features, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+        kernel_init=nn.initializers.normal(
+            cfg.initializer_range * init_scale),
+        bias_init=nn.initializers.zeros,
+        quant_block=cfg.quant_block,
+        stochastic_rounding=cfg.quant_stochastic_rounding,
+        split=split, sr_fallback=sr_fallback, name=name)
+
+
 class GPT2Block(nn.Module):
-    """Pre-LN transformer block (attention + MLP)."""
+    """Pre-LN transformer block (attention + MLP).
+
+    Boundary-fusion contract (tentpole of ISSUE 13(c) — the
+    kernel-labeled `top_fusion_sinks` table ranks the unfused
+    mlp_c_proj-bias + residual-add + next-layer ln_1 chain as the top
+    remaining non-matmul sink of the fused flagship step): when the
+    caller passes `boundary=(prev_mlp_y, prev_mlp_b)` the TRUE hidden
+    state is `hidden + prev_mlp_y + prev_mlp_b`, and this block folds
+    that add into its leading LayerNorm as one fused
+    bias+residual+LN launch. With `return_boundary=True` the block
+    returns `(residual_stream, (mlp_y, mlp_b))` instead of completing
+    its own trailing add — the next block (or the model's final
+    fused ln_f) consumes it. The scan cell threads this carry; plain
+    callers (pipe stages, eval helpers) keep the hidden-in/hidden-out
+    interface with both args defaulted off."""
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, hidden, deterministic: bool = True):
+    def __call__(self, hidden, deterministic: bool = True,
+                 boundary=None, return_boundary: bool = False):
         cfg = self.config
         b, t, c = hidden.shape
 
         from deepspeed_tpu.ops.transformer.fused_ops import (
             fused_bias_gelu, fused_bias_residual_layernorm,
             resolve_fused_ops)
+        from deepspeed_tpu.ops.transformer.quantized_matmul import \
+            resolve_quantized_compute
         # dropout sits between each projection's bias and the residual,
         # so the fused epilogues require it inactive
         use_fused = resolve_fused_ops(
             cfg.fused_ops, deterministic or cfg.dropout == 0.0)
+        use_quant = resolve_quantized_compute(cfg.quantized_compute)
+        if (boundary is not None or return_boundary) and not use_fused:
+            raise ValueError(
+                "GPT2Block boundary fusion requires the fused-ops path "
+                "(resolve_fused_ops must be active for this trace)")
 
-        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32,
-                           param_dtype=cfg.param_dtype, name="ln_1")
+        def proj(features, name, init_scale=1.0, split=False):
+            if use_quant:
+                return _quant_dense(features, cfg, name,
+                                    init_scale=init_scale, split=split)
+            if cfg.quantized_compute not in ("off", False, 0, None) \
+                    and cfg.quant_stochastic_rounding:
+                # quantized compute configured but resolved OFF on
+                # this backend, with stochastic_rounding: the
+                # documented bf16 fallback — plain GEMM with
+                # stochastically rounded operand casts
+                return _quant_dense(features, cfg, name,
+                                    init_scale=init_scale,
+                                    split=split, sr_fallback=True)
+            if split:
+                from deepspeed_tpu.ops.transformer.transformer import \
+                    SplitDense
+                return SplitDense(
+                    features, dtype=cfg.dtype,
+                    param_dtype=cfg.param_dtype,
+                    kernel_init=nn.initializers.normal(
+                        cfg.initializer_range * init_scale),
+                    name=name)
+            return _dense(features, cfg, name, init_scale=init_scale)
+
         if use_fused:
-            from deepspeed_tpu.ops.transformer.transformer import LNParams
+            from deepspeed_tpu.ops.transformer.transformer import (
+                LNParams, plain_layernorm)
+            ln1_p = LNParams(param_dtype=cfg.param_dtype,
+                             name="ln_1")(cfg.n_embd)
             ln2_p = LNParams(param_dtype=cfg.param_dtype,
                              name="ln_2")(cfg.n_embd)
         else:
+            ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                               dtype=jnp.float32,
+                               param_dtype=cfg.param_dtype, name="ln_1")
             ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
                                dtype=jnp.float32,
                                param_dtype=cfg.param_dtype, name="ln_2")
 
         # --- attention ---
-        x = ln1(hidden).astype(cfg.dtype)
-        qkv = _dense(3 * cfg.n_embd, cfg, "c_attn")(x)
+        if use_fused and boundary is not None:
+            # one launch: previous block's mlp_c_proj bias + residual
+            # + this block's ln_1 (the boundary chain); `hidden`
+            # becomes the true residual stream
+            prev_y, prev_b = boundary
+            x, hidden = fused_bias_residual_layernorm(
+                prev_y, prev_b, hidden, *ln1_p,
+                eps=cfg.layer_norm_epsilon, out_dtype=cfg.dtype,
+                sum_dtype=jnp.result_type(hidden.dtype, cfg.dtype))
+        elif use_fused:
+            x = plain_layernorm(hidden, *ln1_p,
+                                eps=cfg.layer_norm_epsilon) \
+                .astype(cfg.dtype)
+        else:
+            x = ln1(hidden).astype(cfg.dtype)
+        qkv = proj(3 * cfg.n_embd, "c_attn")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
         k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
@@ -229,42 +327,44 @@ class GPT2Block(nn.Module):
         attn = _attention(cfg, q, k, v, drop_rng, deterministic)
         attn = attn.reshape(b, t, cfg.n_embd)
         if use_fused:
-            from deepspeed_tpu.ops.transformer.transformer import \
-                SplitDense
-            proj_init = nn.initializers.normal(
-                cfg.initializer_range / np.sqrt(2 * cfg.n_layer))
-            attn_y, attn_b = SplitDense(
-                cfg.n_embd, dtype=cfg.dtype, param_dtype=cfg.param_dtype,
-                kernel_init=proj_init, name="c_proj")(attn)
+            attn_y, attn_b = proj(
+                cfg.n_embd, "c_proj",
+                init_scale=1.0 / np.sqrt(2 * cfg.n_layer),
+                split=True)(attn)
             # one launch: c_proj bias + residual + ln_2; `hidden`
             # carries on un-normalized (pre-LN)
             y, hidden = fused_bias_residual_layernorm(
                 attn_y, attn_b, hidden, *ln2_p,
                 eps=cfg.layer_norm_epsilon, out_dtype=cfg.dtype,
                 sum_dtype=jnp.result_type(hidden.dtype, cfg.dtype))
-            fc_y, fc_b = SplitDense(
-                4 * cfg.n_embd, dtype=cfg.dtype,
-                param_dtype=cfg.param_dtype,
-                kernel_init=nn.initializers.normal(
-                    cfg.initializer_range), name="c_fc")(y)
+            fc_y, fc_b = proj(4 * cfg.n_embd, "c_fc", split=True)(y)
             # GPT-2 uses the tanh GeLU approximation
             y = fused_bias_gelu(fc_y, fc_b, approximate=True,
                                 out_dtype=cfg.dtype)
-            y = _dense(cfg.n_embd, cfg, "mlp_c_proj",
-                       init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
+            if return_boundary:
+                # the trailing bias+residual add is NOT completed
+                # here: the next block's fused ln_1 (or the model's
+                # fused ln_f) consumes it as its boundary input
+                mlp_y, mlp_b = proj(
+                    cfg.n_embd, "mlp_c_proj",
+                    init_scale=1.0 / np.sqrt(2 * cfg.n_layer),
+                    split=True)(y)
+                return hidden, (mlp_y, mlp_b)
+            y = proj(cfg.n_embd, "mlp_c_proj",
+                     init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
             return hidden + y
         # proj init scaled down by depth (GPT-2 residual-scaling trick)
-        attn = _dense(cfg.n_embd, cfg, "c_proj",
-                      init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(attn)
+        attn = proj(cfg.n_embd, "c_proj",
+                    init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(attn)
         attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
         hidden = hidden + attn
 
         # --- MLP ---
         y = ln2(hidden).astype(cfg.dtype)
-        y = _dense(4 * cfg.n_embd, cfg, "c_fc")(y)
+        y = proj(4 * cfg.n_embd, "c_fc")(y)
         y = nn.gelu(y, approximate=True)
-        y = _dense(cfg.n_embd, cfg, "mlp_c_proj",
-                   init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
+        y = proj(cfg.n_embd, "mlp_c_proj",
+                 init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         return hidden + y
 
@@ -316,7 +416,7 @@ class GPT2LMHeadModel(nn.Module):
         ScannedBlocks = nn.scan(
             _BlockScanCell,
             variable_axes={"params": 0},
-            split_rngs={"params": True, "dropout": True},
+            split_rngs={"params": True, "dropout": True, "quant": True},
             in_axes=(nn.broadcast, nn.broadcast),
             length=cfg.n_layer,
             metadata_params={nn.meta.PARTITION_NAME: "layers"},
@@ -325,12 +425,43 @@ class GPT2LMHeadModel(nn.Module):
         # per step (ref `progressive_layer_drop.py:5`), applied as a
         # bernoulli gate on each block's residual inside the scan.
         keep = layer_keep_prob if layer_keep_prob is not None else None
-        hidden, _ = ScannedBlocks(cfg, name="h")(hidden, deterministic, keep)
-
-        hidden = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
-                              dtype=jnp.float32,
-                              param_dtype=cfg.param_dtype,
-                              name="ln_f")(hidden)
+        from deepspeed_tpu.ops.transformer.fused_ops import (
+            fused_bias_residual_layernorm, resolve_fused_ops)
+        # Boundary fusion (ISSUE 13(c)): under the fused path each
+        # layer boundary's mlp_c_proj-bias + residual-add + next ln_1
+        # runs as ONE fused launch — the scan carries
+        # (residual_stream, (mlp_y, mlp_b)) instead of the completed
+        # hidden state, and the final boundary folds into a fused
+        # ln_f the same way. PLD gates on completed block outputs, so
+        # it keeps the plain carry.
+        use_boundary = keep is None and resolve_fused_ops(
+            cfg.fused_ops, deterministic or cfg.dropout == 0.0)
+        if use_boundary:
+            from deepspeed_tpu.ops.transformer.transformer import \
+                LNParams
+            # the zero bias seeds the first boundary; its dtype must
+            # match the bias params AS APPLIED (the engine hands the
+            # compute-dtype cast of the tree to bf16 traces), which
+            # wte's runtime dtype tracks exactly
+            carry0 = (hidden,
+                      (jnp.zeros(hidden.shape, cfg.dtype),
+                       jnp.zeros((cfg.n_embd,), wte.dtype)))
+            (resid, (mlp_y, mlp_b)), _ = ScannedBlocks(
+                cfg, name="h")(carry0, deterministic, keep)
+            lnf_p = LNParams(param_dtype=cfg.param_dtype,
+                             name="ln_f")(cfg.n_embd)
+            hidden = fused_bias_residual_layernorm(
+                mlp_y, mlp_b, resid, *lnf_p,
+                eps=cfg.layer_norm_epsilon, out_dtype=jnp.float32,
+                return_sum=False)
+        else:
+            hidden, _ = ScannedBlocks(cfg, name="h")(hidden,
+                                                     deterministic,
+                                                     keep)
+            hidden = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                  dtype=jnp.float32,
+                                  param_dtype=cfg.param_dtype,
+                                  name="ln_f")(hidden)
         if return_hidden:
             # fused-head path: the caller computes loss chunkwise against
             # wte without materialising [B, T, vocab] logits
@@ -341,20 +472,34 @@ class GPT2LMHeadModel(nn.Module):
 
 
 class _BlockScanCell(nn.Module):
-    """Scan cell: threads hidden through one (optionally rematted,
-    optionally stochastic-depth-gated) block; returns (carry, None)."""
+    """Scan cell: threads the carry through one (optionally rematted,
+    optionally stochastic-depth-gated) block; returns (carry, None).
+
+    Two carry shapes: a plain hidden array (the historical interface;
+    PLD and the unfused path), or the boundary-fused tuple
+    (residual_stream, (mlp_y, mlp_b)) — the block then folds the
+    previous boundary into its fused ln_1 and leaves its own boundary
+    open for the next cell (see GPT2Block's boundary contract)."""
     config: GPT2Config
 
     @nn.compact
-    def __call__(self, hidden, deterministic, keep_prob):
+    def __call__(self, carry, deterministic, keep_prob):
         cfg = self.config
+        boundary_mode = isinstance(carry, tuple)
         block_cls = GPT2Block
         if cfg.remat:
+            # static argnums index flax-remat call args with the
+            # module at 0: deterministic=2, return_boundary=4
             block_cls = nn.remat(GPT2Block, prevent_cse=False,
-                                 static_argnums=(2,),
+                                 static_argnums=(2, 4),
                                  policy=resolve_remat_policy(
                                      cfg.remat_policy))
-        out = block_cls(cfg)(hidden, deterministic)
+        if boundary_mode:
+            hidden, prev = carry
+            return block_cls(cfg)(hidden, deterministic, prev,
+                                  True), None
+        hidden = carry
+        out = block_cls(cfg)(hidden, deterministic, None, False)
         if keep_prob is not None:
             if deterministic:
                 out = hidden + keep_prob * (out - hidden)
@@ -439,6 +584,25 @@ class GPT2ForCausalLM:
         tree is IDENTICAL either way — checkpoints interchange."""
         self._zero3 = sched
 
+    def configure_quantized_compute(self, mode, block=None,
+                                    stochastic_rounding=None):
+        """Engine hook for the `quantized_compute` config block:
+        rebuild the module with the int8 quantized-compute projection
+        family switched to `mode` ("off"|"on"|"auto"). The parameter
+        tree is IDENTICAL either way — existing checkpoints load
+        unchanged and the toggle can flip mid-run between traces."""
+        from deepspeed_tpu.ops.transformer.quantized_matmul import \
+            resolve_quantized_compute
+        resolve_quantized_compute(mode)   # ValueError on bad mode
+        updates = {"quantized_compute": mode}
+        if block is not None:
+            updates["quant_block"] = int(block)
+        if stochastic_rounding is not None:
+            updates["quant_stochastic_rounding"] = \
+                bool(stochastic_rounding)
+        self.config = dataclasses.replace(self.config, **updates)
+        self.module = GPT2LMHeadModel(self.config)
+
     def init(self, rng, example_batch):
         input_ids = example_batch["input_ids"]
         variables = self.module.init({"params": rng, "dropout": rng},
@@ -521,20 +685,40 @@ class GPT2ForCausalLM:
 
         stacked = stacked_block_params(params)
         block = GPT2Block(cfg)
-
-        def body(lp, h, rng_k):
-            return block.apply({"params": lp}, h, deterministic)
-
         base_rng = (rngs or {}).get("dropout", jax.random.PRNGKey(0))
-        hidden = sched.apply_layers(body, stacked, hidden, base_rng,
-                                    name="h")
+        from deepspeed_tpu.ops.transformer.fused_ops import (
+            fused_bias_residual_layernorm, resolve_fused_ops)
+        # mirror the module path's boundary fusion (dropout is
+        # inactive here by the _zero3_active gate) so scheduled and
+        # unscheduled traces run the same op sequence
+        use_boundary = resolve_fused_ops(cfg.fused_ops, True)
+        lnf_params = sched.gather(params["ln_f"], name="ln_f")
 
-        ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
-                            dtype=jnp.float32,
-                            param_dtype=cfg.param_dtype)
-        hidden = ln_f.apply(
-            {"params": sched.gather(params["ln_f"], name="ln_f")},
-            hidden)
+        if use_boundary:
+            def body(lp, carry, rng_k):
+                h, prev = carry
+                return block.apply({"params": lp}, h, deterministic,
+                                   prev, True)
+
+            carry0 = (hidden,
+                      (jnp.zeros(hidden.shape, cfg.dtype),
+                       jnp.zeros((cfg.n_embd,), wte.dtype)))
+            resid, (mlp_y, mlp_b) = sched.apply_layers(
+                body, stacked, carry0, base_rng, name="h")
+            hidden = fused_bias_residual_layernorm(
+                mlp_y, mlp_b, resid, lnf_params["scale"],
+                lnf_params["bias"], eps=cfg.layer_norm_epsilon,
+                out_dtype=jnp.float32, return_sum=False)
+        else:
+            def body(lp, h, rng_k):
+                return block.apply({"params": lp}, h, deterministic)
+
+            hidden = sched.apply_layers(body, stacked, hidden,
+                                        base_rng, name="h")
+            ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                dtype=jnp.float32,
+                                param_dtype=cfg.param_dtype)
+            hidden = ln_f.apply({"params": lnf_params}, hidden)
         return chunked_tied_head_loss(hidden.astype(cfg.dtype), wte,
                                       labels)
 

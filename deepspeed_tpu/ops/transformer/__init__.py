@@ -4,8 +4,11 @@ from deepspeed_tpu.ops.transformer.flash_attention import (
     flash_attention, flash_attention_usable)
 from deepspeed_tpu.ops.transformer.fused_ops import (
     fused_bias_gelu, fused_bias_residual_layernorm, resolve_fused_ops)
+from deepspeed_tpu.ops.transformer.quantized_matmul import (
+    quantized_dense, quantized_matmul, resolve_quantized_compute)
 
 __all__ = ["DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
            "flash_attention", "flash_attention_usable",
            "fused_bias_gelu", "fused_bias_residual_layernorm",
-           "resolve_fused_ops"]
+           "resolve_fused_ops", "quantized_dense", "quantized_matmul",
+           "resolve_quantized_compute"]

@@ -121,7 +121,7 @@ def _fit_block(block, t):
 
 
 def flash_attention_usable(q, no_dropout: bool,
-                           block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK):
+                           block_q=None, block_k=None):
     """The kernel handles [B, T, H, D] with T divisible by the block size
     and D a lane-friendly multiple of 64; dropout stays on the XLA path."""
     if not no_dropout:
@@ -129,8 +129,8 @@ def flash_attention_usable(q, no_dropout: bool,
     if q.ndim != 4:
         return False
     t, d = q.shape[1], q.shape[3]
-    block_q = _fit_block(block_q, t)
-    block_k = _fit_block(block_k, t)
+    block_q = _fit_block(block_q or _DEFAULT_BLOCK, t)
+    block_k = _fit_block(block_k or _DEFAULT_BLOCK, t)
     # t % 128 guards the lane dimension: _fit_block clamps the block to
     # t for 128 <= t < 1024, so without it a T like 136 would "fit" its
     # own single tile — unaligned lanes Mosaic rejects or pads on real
@@ -974,7 +974,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention_with_lse(q, k, v, causal=True, sm_scale=None,
-                             block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK,
+                             block_q=None, block_k=None,
                              interpret=None, head_packing="auto"):
     """Flash attention returning (out [B,T,H,D], lse [B,H,T,1]).
 
@@ -1066,8 +1066,8 @@ _flash_merge.defvjp(_flash_merge_fwd, _flash_merge_bwd)
 
 
 def flash_attention_merge(q, k, v, prev_out, prev_lse, causal=True,
-                          sm_scale=None, block_q=_DEFAULT_BLOCK,
-                          block_k=_DEFAULT_BLOCK, interpret=None,
+                          sm_scale=None, block_q=None,
+                          block_k=None, interpret=None,
                           head_packing="auto"):
     """Flash attention over one KV block, merged IN THE KERNEL EPILOGUE
     with a prior softmax partial over a disjoint key set.
@@ -1136,6 +1136,25 @@ def _normalize_flash_args(q, k, v, causal, sm_scale, block_q, block_k,
     guarantees identical numerics)."""
     assert q.shape == k.shape == v.shape, (q.shape, k.shape, v.shape)
     t = q.shape[1]
+    if block_q is None and block_k is None:
+        # caller did not pick tiles (None is the sentinel — an
+        # EXPLICIT 1024/1024 stays 1024/1024): consult the autotune
+        # table (a pure host-side dict lookup at trace time; returns
+        # only divisors of t, validated on load), else the
+        # hand-picked default.
+        from deepspeed_tpu.ops import autotune
+        if interpret is None:
+            _interp_probe = not _on_tpu()
+        else:
+            _interp_probe = bool(interpret)
+        _pack_probe = _resolve_head_packing(head_packing, q.shape[-1],
+                                            _interp_probe)
+        tuned = autotune.flash_blocks(t, q.shape[-1], bool(causal),
+                                      _pack_probe, q.dtype)
+        if tuned is not None:
+            block_q, block_k = tuned
+    block_q = _DEFAULT_BLOCK if block_q is None else block_q
+    block_k = _DEFAULT_BLOCK if block_k is None else block_k
     block_q = _fit_block(block_q, t)
     block_k = _fit_block(block_k, t)
     assert t % block_q == 0 and t % block_k == 0, (
@@ -1152,8 +1171,8 @@ def _normalize_flash_args(q, k, v, causal, sm_scale, block_q, block_k,
 
 
 def flash_attention_rematerializable(q, k, v, causal=True, sm_scale=None,
-                                     block_q=_DEFAULT_BLOCK,
-                                     block_k=_DEFAULT_BLOCK,
+                                     block_q=None,
+                                     block_k=None,
                                      interpret=None, head_packing="auto"):
     """flash_attention whose (out, lse) carry checkpoint_name
     annotations ("attn_out"/"attn_lse") so a names-saving remat policy
@@ -1173,7 +1192,7 @@ def flash_attention_rematerializable(q, k, v, causal=True, sm_scale=None,
 
 
 def flash_attention(q, k, v, causal=True, sm_scale=None,
-                    block_q=_DEFAULT_BLOCK, block_k=_DEFAULT_BLOCK,
+                    block_q=None, block_k=None,
                     interpret=None, head_packing="auto"):
     """Flash attention over [B, T, H, D] tensors; returns [B, T, H, D].
 

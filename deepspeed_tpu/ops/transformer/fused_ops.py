@@ -128,6 +128,19 @@ def _row_block(n, target=256):
     return max(blk, 1)
 
 
+_DEFAULT_ROW_BLOCK = 256
+
+
+def _tuned_row_block(kernel, n, hp, dtype):
+    """Row-block for one launch: the autotune table's winner for this
+    (kernel, backend, dtype, shape-class) when one exists, else the
+    hand-picked 256 target. Pure host-side dict lookup at trace time
+    (no device sync)."""
+    from deepspeed_tpu.ops import autotune
+    target = autotune.row_block_target(kernel, n, hp, dtype)
+    return _row_block(n, target or _DEFAULT_ROW_BLOCK)
+
+
 # ----------------------------------------------------------------------
 # shared math (the kernels and the XLA fallback use the SAME formulas,
 # so interpret-mode parity tests pin the kernel logic itself)
@@ -316,7 +329,7 @@ def _ln_fwd_launch(y2, bias, res2, gamma, beta, eps, h, out_dtype,
     kernel masks pad lanes out of the statistics) and tiles rows."""
     n = y2.shape[0]
     hp = -(-h // 128) * 128
-    blk = _row_block(n)
+    blk = _tuned_row_block("fused_ln", n, hp, out_dtype)
     args = [_pad_lanes(y2, hp), _pad_lanes(bias[None], hp),
             _pad_lanes(res2, hp), _pad_lanes(gamma[None], hp),
             _pad_lanes(beta[None], hp)]
@@ -338,7 +351,7 @@ def _ln_bwd_launch(s2, gamma, dout2, dsum2, eps, h, in_dtype,
                    param_dtype, interpret):
     n = s2.shape[0]
     hp = -(-h // 128) * 128
-    blk = _row_block(n)
+    blk = _tuned_row_block("fused_ln", n, hp, in_dtype)
     has_dsum = dsum2 is not None
     args = [_pad_lanes(s2, hp), _pad_lanes(gamma[None], hp),
             _pad_lanes(dout2, hp)]
@@ -371,7 +384,7 @@ def _gelu_fwd_launch(x2, bias, approximate, h, out_dtype, sum_dtype,
                      interpret):
     n = x2.shape[0]
     hp = -(-h // 128) * 128
-    blk = _row_block(n)
+    blk = _tuned_row_block("fused_gelu", n, hp, out_dtype)
     row_spec = pl.BlockSpec((blk, hp), lambda i: (i, 0))
     vec_spec = pl.BlockSpec((1, hp), lambda i: (0, 0))
     out, s = _pallas_call(
@@ -391,7 +404,7 @@ def _gelu_bwd_launch(s2, dout2, approximate, h, in_dtype, param_dtype,
                      interpret):
     n = s2.shape[0]
     hp = -(-h // 128) * 128
-    blk = _row_block(n)
+    blk = _tuned_row_block("fused_gelu", n, hp, in_dtype)
     row_spec = pl.BlockSpec((blk, hp), lambda i: (i, 0))
     vec_spec = pl.BlockSpec((1, hp), lambda i: (0, 0))
     dx, dbias = _pallas_call(

@@ -56,6 +56,68 @@ class SplitDense(nn.Module):
         return y, bias
 
 
+class QuantizedDense(nn.Module):
+    """nn.Dense/SplitDense-compatible "kernel"/"bias" parameters whose
+    forward matmul runs the int8 quantized-compute family
+    (ops/transformer/quantized_matmul.py): weights re-quantize
+    per-(K-block, N-column) inside every trace, activations quantize
+    per row, the MXU contracts int8xint8 and the dequant rides the
+    GEMM epilogue; the backward is straight-through in the compute
+    dtype.  The parameter tree is IDENTICAL to nn.Dense/SplitDense —
+    checkpoints interchange freely and quantized compute can be
+    toggled on an existing run.
+
+    split=True returns `(x @ kernel, bias)` (the SplitDense contract,
+    so the bias keeps riding a fused epilogue); split=False adds the
+    bias like nn.Dense.  Stochastic rounding engages when the caller
+    provides a "quant" rng stream (the engine threads one per step);
+    without it rounding is to-nearest.
+
+    sr_fallback=True is the backward-compatible bf16 fallback of the
+    family (quantized compute configured with stochastic_rounding but
+    RESOLVED off on this backend): no int8 quantization — a plain
+    compute-dtype GEMM whose fp32->bf16 operand casts round
+    stochastically off the same "quant" stream
+    (`bf16_fallback_matmul`); without the rng it is bit-for-bit
+    nn.Dense/SplitDense."""
+    features: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+    bias_init: Any = nn.initializers.zeros
+    quant_block: int = 128
+    stochastic_rounding: bool = False
+    split: bool = False
+    quant_impl: str = "auto"
+    sr_fallback: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        from deepspeed_tpu.ops.transformer.quantized_matmul import (
+            bf16_fallback_matmul, quantized_dense)
+        kernel = self.param("kernel", self.kernel_init,
+                            (x.shape[-1], self.features),
+                            self.param_dtype)
+        bias = self.param("bias", self.bias_init, (self.features,),
+                          self.param_dtype)
+        rng = None
+        if self.stochastic_rounding and self.has_rng("quant"):
+            rng = self.make_rng("quant")
+        if self.sr_fallback:
+            y = bf16_fallback_matmul(
+                x.astype(self.dtype), kernel, out_dtype=self.dtype,
+                stochastic_rounding=self.stochastic_rounding, rng=rng)
+        else:
+            y = quantized_dense(
+                x.astype(self.dtype), kernel, block=self.quant_block,
+                out_dtype=self.dtype,
+                stochastic_rounding=self.stochastic_rounding,
+                rng=rng, impl=self.quant_impl)
+        if self.split:
+            return y, bias
+        return y + bias.astype(self.dtype)
+
+
 class LNParams(nn.Module):
     """LayerNorm-compatible "scale"/"bias" parameters without applying
     the norm — the fused bias+residual+LayerNorm kernel applies it."""
@@ -112,7 +174,10 @@ class DeepSpeedTransformerConfig:
                  bf16=False,
                  layer_norm_eps=1e-12,
                  head_packing="auto",
-                 fused_ops="auto"):
+                 fused_ops="auto",
+                 quantized_compute="off",
+                 quant_block=128,
+                 quant_stochastic_rounding=False):
         self.batch_size = batch_size
         self.max_seq_length = max_seq_length
         self.hidden_size = hidden_size
@@ -152,6 +217,16 @@ class DeepSpeedTransformerConfig:
         # fallback off-TPU — same custom VJP, same remat names); the
         # parameter tree is identical either way.
         self.fused_ops = fused_ops
+        # int8 quantized-compute projections ("off"|"on"|"auto"): the
+        # third epilogue family — forward matmuls contract int8xint8
+        # with per-(K-block, column) weight scales and per-row
+        # activation scales dequantized in the GEMM epilogue
+        # (ops/transformer/quantized_matmul.py), straight-through
+        # backward in the compute dtype. "auto" enables on real TPU;
+        # the parameter tree is identical either way.
+        self.quantized_compute = quantized_compute
+        self.quant_block = quant_block
+        self.quant_stochastic_rounding = quant_stochastic_rounding
 
     @classmethod
     def from_dict(cls, json_object):
@@ -192,7 +267,24 @@ class _TransformerLayerCore(nn.Module):
                 2.0 * cfg.num_hidden_layers)
         out_init = nn.initializers.normal(out_scale)
 
+        from deepspeed_tpu.ops.transformer.quantized_matmul import \
+            resolve_quantized_compute
+        use_quant = resolve_quantized_compute(cfg.quantized_compute)
+        # configured-but-resolved-off + stochastic_rounding: the
+        # documented bf16 fallback (plain GEMM, SR operand casts)
+        use_sr_fallback = (
+            not use_quant and
+            cfg.quantized_compute not in ("off", False, 0, None) and
+            cfg.quant_stochastic_rounding)
+
         def dense(features, name, kernel_init=init):
+            if use_quant or use_sr_fallback:
+                return QuantizedDense(
+                    features, dtype=compute_dtype,
+                    param_dtype=jnp.float32, kernel_init=kernel_init,
+                    quant_block=cfg.quant_block,
+                    stochastic_rounding=cfg.quant_stochastic_rounding,
+                    sr_fallback=use_sr_fallback, name=name)
             return nn.Dense(features, dtype=compute_dtype,
                             param_dtype=jnp.float32,
                             kernel_init=kernel_init, name=name)
@@ -213,6 +305,16 @@ class _TransformerLayerCore(nn.Module):
             ln_out_p = LNParams(name="layer_norm")(h)
 
             def split_dense(features, name, kernel_init=init):
+                if use_quant or use_sr_fallback:
+                    return QuantizedDense(
+                        features, dtype=compute_dtype,
+                        param_dtype=jnp.float32,
+                        kernel_init=kernel_init,
+                        quant_block=cfg.quant_block,
+                        stochastic_rounding=cfg
+                        .quant_stochastic_rounding,
+                        split=True, sr_fallback=use_sr_fallback,
+                        name=name)
                 return SplitDense(features, dtype=compute_dtype,
                                   param_dtype=jnp.float32,
                                   kernel_init=kernel_init, name=name)

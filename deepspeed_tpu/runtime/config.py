@@ -377,6 +377,52 @@ def get_async_dispatch_prefetch_depth(param_dict):
     return int(val)
 
 
+def get_quantized_compute_config(param_dict):
+    """Validated `quantized_compute` block -> dict(enabled, mode,
+    block, stochastic_rounding)."""
+    block = param_dict.get(C.QUANTIZED_COMPUTE, {})
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f'"quantized_compute" must be a dict, got {block!r}')
+    enabled = bool(get_scalar_param(
+        block, C.QUANTIZED_COMPUTE_ENABLED,
+        C.QUANTIZED_COMPUTE_ENABLED_DEFAULT))
+    mode = get_scalar_param(block, C.QUANTIZED_COMPUTE_MODE,
+                            C.QUANTIZED_COMPUTE_MODE_DEFAULT)
+    if mode not in C.QUANTIZED_COMPUTE_MODE_VALID:
+        raise DeepSpeedConfigError(
+            f"quantized_compute.mode must be one of "
+            f"{list(C.QUANTIZED_COMPUTE_MODE_VALID)}, got {mode!r}")
+    qblock = get_scalar_param(block, C.QUANTIZED_COMPUTE_BLOCK,
+                              C.QUANTIZED_COMPUTE_BLOCK_DEFAULT)
+    if not isinstance(qblock, int) or isinstance(qblock, bool) or \
+            qblock < 1:
+        raise DeepSpeedConfigError(
+            f"quantized_compute.block must be an int >= 1, got "
+            f"{qblock!r}")
+    sr = bool(get_scalar_param(
+        block, C.QUANTIZED_COMPUTE_STOCHASTIC_ROUNDING,
+        C.QUANTIZED_COMPUTE_STOCHASTIC_ROUNDING_DEFAULT))
+    return {"enabled": enabled, "mode": mode, "block": qblock,
+            "stochastic_rounding": sr}
+
+
+def get_autotune_config(param_dict):
+    """Validated `autotune` block -> dict(enabled, table_path)."""
+    block = param_dict.get(C.AUTOTUNE, {})
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f'"autotune" must be a dict, got {block!r}')
+    enabled = bool(get_scalar_param(block, C.AUTOTUNE_ENABLED,
+                                    C.AUTOTUNE_ENABLED_DEFAULT))
+    path = get_scalar_param(block, C.AUTOTUNE_TABLE_PATH,
+                            C.AUTOTUNE_TABLE_PATH_DEFAULT)
+    if not isinstance(path, str):
+        raise DeepSpeedConfigError(
+            f"autotune.table_path must be a string, got {path!r}")
+    return {"enabled": enabled, "table_path": path}
+
+
 class DeepSpeedConfigWriter:
     """Minimal key-value holder used by tests/tools to compose configs."""
 
@@ -547,6 +593,9 @@ class DeepSpeedConfig:
             get_async_dispatch_steps_per_sync(param_dict)
         self.async_dispatch_prefetch_depth = \
             get_async_dispatch_prefetch_depth(param_dict)
+
+        self.quantized_compute = get_quantized_compute_config(param_dict)
+        self.autotune = get_autotune_config(param_dict)
 
         self.pld_enabled = get_pld_enabled(param_dict)
         self.pld_params = get_pld_params(param_dict)

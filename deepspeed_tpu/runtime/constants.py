@@ -470,6 +470,60 @@ STAGE3_GATHER_DTYPE_DEFAULT = None
 STAGE3_GATHER_DTYPE_VALID = (None, "fp32", "bf16", "fp16")
 
 #############################################
+# Quantized compute (TPU-native extension): int8 quantized-compute
+# forward GEMMs as the third fused-ops epilogue family
+# (ops/transformer/quantized_matmul.py) — per-(K-block, N-column)
+# weight scales + per-row activation scales, dequant fused into the
+# GEMM epilogue, straight-through backward in the compute dtype.
+#   {"quantized_compute": {"enabled": true, "mode": "auto",
+#                          "block": 128,
+#                          "stochastic_rounding": false}}
+# enabled: wire the family into supporting models at engine init (the
+#   model's configure_quantized_compute hook; models without the hook
+#   warn and stay unquantized).
+# mode: "auto" quantizes on real TPU only (the fused_ops convention —
+#   CPU numerics stay bit-identical by default); "on" forces the path
+#   anywhere (XLA fallback reproduces the same quantization
+#   numerics); "off" parks the config without unwiring it.
+# block: quantization block along the contraction dim. Must be a
+#   multiple of 128 on the Pallas path (int8 lane tiling).
+# stochastic_rounding: round the int8 quantization stochastically
+#   (unbiased) using the per-step "quant" rng stream the engine
+#   threads next to "dropout"; also makes the no-quantization bf16
+#   fallback use stochastically rounded fp32->bf16 operand casts.
+#############################################
+QUANTIZED_COMPUTE = "quantized_compute"
+QUANTIZED_COMPUTE_ENABLED = "enabled"
+QUANTIZED_COMPUTE_ENABLED_DEFAULT = False
+QUANTIZED_COMPUTE_MODE = "mode"
+QUANTIZED_COMPUTE_MODE_DEFAULT = "auto"
+QUANTIZED_COMPUTE_MODE_VALID = ("auto", "on", "off")
+QUANTIZED_COMPUTE_BLOCK = "block"
+QUANTIZED_COMPUTE_BLOCK_DEFAULT = 128
+QUANTIZED_COMPUTE_STOCHASTIC_ROUNDING = "stochastic_rounding"
+QUANTIZED_COMPUTE_STOCHASTIC_ROUNDING_DEFAULT = False
+
+#############################################
+# Kernel block-size autotuner (TPU-native extension): measured
+# grid/block shapes for the Pallas kernels (flash, packed flash,
+# fused epilogues, quantized GEMM), persisted as a versioned JSON
+# next to the jax compile cache and consulted transparently at trace
+# time (ops/autotune.py). Entries carry the kernel module's source
+# hash — a kernel edit invalidates them (defaults, one warning).
+#   {"autotune": {"enabled": true, "table_path": ""}}
+# enabled: consult the table at trace time (searches are explicit —
+#   the autotune_flash bench leg or ops.autotune.search; nothing
+#   searches inside a training step).
+# table_path: "" = next to the jax compilation cache
+#   (autotune_table_v1.json), else an explicit JSON path.
+#############################################
+AUTOTUNE = "autotune"
+AUTOTUNE_ENABLED = "enabled"
+AUTOTUNE_ENABLED_DEFAULT = True
+AUTOTUNE_TABLE_PATH = "table_path"
+AUTOTUNE_TABLE_PATH_DEFAULT = ""
+
+#############################################
 # Inference/serving engine (TPU-native extension): AOT-compiled
 # prefill + single-token decode over a device-resident paged KV cache
 # with continuous batching (deepspeed_tpu/inference/), configured

@@ -277,6 +277,8 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._steps_per_sync = \
             self._config.async_dispatch_steps_per_sync or \
             self.steps_per_print()
+        self._init_autotune()
+        self._init_quantized_compute()
         self._configure_optimizer()
         self._configure_lr_scheduler(lr_scheduler)
         self._init_state()
@@ -944,6 +946,53 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._register_memory_ledger()
         self._initial_params = None   # don't pin the caller's copy
 
+    def _init_autotune(self):
+        """Wire the kernel block-size autotuner (ops/autotune.py):
+        apply the `autotune` config block (enabled toggle + table
+        path) and attach the monitor so `autotune_search` /
+        `autotune_hit` events flow to the sinks. Lookups then happen
+        transparently inside the kernel entry points at trace time —
+        pure host-side dict reads, no device sync."""
+        from deepspeed_tpu.ops import autotune
+        at = self._config.autotune
+        autotune.configure(
+            enabled=at["enabled"],
+            table_path=at["table_path"],
+            monitor=self.monitor if self.monitor.enabled else False)
+
+    def _init_quantized_compute(self):
+        """Wire the `quantized_compute` config block into the model:
+        call its `configure_quantized_compute` hook (GPT-2 family)
+        with the configured mode/block/stochastic_rounding, emit one
+        `quantized_matmul` monitor event recording the configuration,
+        and warn when the model does not expose the hook (the config
+        then has no effect on this model)."""
+        qc = self._config.quantized_compute
+        if not qc["enabled"]:
+            return
+        target = getattr(self, "module", None)
+        hook = getattr(target, "configure_quantized_compute", None)
+        if hook is None:
+            logger.warning(
+                "quantized_compute.enabled is set but the model "
+                f"({type(target).__name__}) exposes no "
+                "configure_quantized_compute hook; forward matmuls "
+                "stay unquantized")
+            applied = False
+        else:
+            hook(qc["mode"], block=qc["block"],
+                 stochastic_rounding=qc["stochastic_rounding"])
+            applied = True
+        if self.monitor.enabled:
+            from deepspeed_tpu.ops.transformer.quantized_matmul \
+                import resolve_quantized_compute
+            self.monitor.event(
+                "quantized_matmul", applied=applied,
+                mode=qc["mode"], block=qc["block"],
+                stochastic_rounding=qc["stochastic_rounding"],
+                active=bool(applied and
+                            resolve_quantized_compute(qc["mode"])))
+
     def _init_zero3_scheduler(self, effective_stage):
         """Build + bind the explicit ZeRO-3 gather/release runtime
         (runtime/zero/stage3.py): layer-granular all-gather prefetched
@@ -1053,7 +1102,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         None unless numerics health is on AND the model resolution
         provided a boundary-tapping loss (`_loss_and_health_fn`)."""
         gas = self._jit_gas()
-        rngs = {"dropout": rng, "params": rng}
+        # "quant" is the per-step stream the quantized-compute family's
+        # stochastic rounding consumes (decorrelated from dropout by the
+        # fold; models without quantized modules never draw from it)
+        rngs = {"dropout": rng, "params": rng,
+                "quant": jax.random.fold_in(rng, 0x51)}
         kwargs = {}
         if self.progressive_layer_drop is not None:
             kwargs["layer_keep_prob"] = keep_prob

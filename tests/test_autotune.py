@@ -1,0 +1,289 @@
+"""Kernel block-size autotuner (ISSUE 13): table lifecycle —
+roundtrip persist/load, kernel-source-hash invalidation, corrupt /
+version-stale tables degrading to defaults with a single warning (no
+crash, no silent reuse) — plus the search's never-slower floor, the
+monitor events, and the trace-time lookups the kernel entry points
+make (fused row blocks, flash blocks)."""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops import autotune
+
+
+@pytest.fixture(autouse=True)
+def _isolated_table(tmp_path):
+    """Every test gets its own table file and a clean module state."""
+    autotune.reset()
+    autotune.configure(table_path=str(tmp_path / "table.json"))
+    yield tmp_path
+    autotune.reset()
+
+
+class _StubMonitor:
+    def __init__(self):
+        self.events = []
+
+    def event(self, kind, **fields):
+        self.events.append((kind, fields))
+
+
+class _capture_warnings:
+    """The ds logger has propagate=False, so caplog misses it; attach
+    a list handler directly."""
+
+    def __enter__(self):
+        from deepspeed_tpu.utils.logging import logger as ds_logger
+        self.records = []
+        outer = self
+
+        class H(logging.Handler):
+            def emit(self, record):
+                outer.records.append(record)
+
+        self._h = H(level=logging.WARNING)
+        self._logger = ds_logger
+        ds_logger.addHandler(self._h)
+        return self
+
+    def __exit__(self, *exc):
+        self._logger.removeHandler(self._h)
+        return False
+
+    def messages(self):
+        return [r.getMessage() for r in self.records]
+
+
+def _fake_search(times_by_block, kernel="fused_ln",
+                 shape_class="rows256_h128", default_block=256,
+                 persist=True):
+    """Drive search() with an injected measure fn (no real kernels)."""
+    return autotune.search(
+        kernel, shape_class, jnp.float32,
+        [{"row_block": b} for b in times_by_block
+         if b != default_block],
+        {"row_block": default_block},
+        measure=lambda p: times_by_block[p["row_block"]],
+        persist=persist)
+
+
+# ----------------------------------------------------------------------
+# search semantics
+# ----------------------------------------------------------------------
+def test_search_picks_fastest_candidate():
+    res = _fake_search({256: 3e-3, 128: 1e-3, 512: 2e-3})
+    assert res["params"] == {"row_block": 128}
+    assert res["speedup_vs_default"] == 3.0
+    assert res["candidates_tried"] == 3
+
+
+def test_search_never_slower_floor():
+    """Every candidate slower than the hand-picked default -> the
+    default IS the recorded winner (applying the table can never
+    regress)."""
+    res = _fake_search({256: 1e-3, 128: 5e-3, 512: 9e-3})
+    assert res["params"] == {"row_block": 256}
+    assert res["speedup_vs_default"] == 1.0
+
+
+def test_search_requires_a_measurement_source():
+    with pytest.raises(ValueError):
+        autotune.search("fused_ln", "s", jnp.float32, [], {})
+
+
+# ----------------------------------------------------------------------
+# persist / load roundtrip + invalidation
+# ----------------------------------------------------------------------
+def test_roundtrip_persist_and_reload(tmp_path):
+    _fake_search({256: 2e-3, 128: 1e-3})
+    # fresh module state, same path: the entry must come back
+    autotune.reset()
+    autotune.configure(table_path=str(tmp_path / "table.json"))
+    params = autotune.lookup("fused_ln", "rows256_h128", jnp.float32)
+    assert params == {"row_block": 128}
+    # the file itself is the versioned document
+    doc = json.load(open(tmp_path / "table.json"))
+    assert doc["version"] == autotune.TABLE_VERSION
+    (key, entry), = doc["entries"].items()
+    assert key.startswith("fused_ln|")
+    assert entry["source_hash"] == \
+        autotune.kernel_source_hash("fused_ln")
+
+
+def test_source_hash_invalidation_single_warning(tmp_path):
+    """An entry measured on different kernel source must NOT steer
+    the current kernel: dropped on lookup, ONE warning, defaults
+    apply."""
+    _fake_search({256: 2e-3, 128: 1e-3})
+    doc = json.load(open(tmp_path / "table.json"))
+    for entry in doc["entries"].values():
+        entry["source_hash"] = "deadbeef"
+    json.dump(doc, open(tmp_path / "table.json", "w"))
+    autotune.reset()
+    autotune.configure(table_path=str(tmp_path / "table.json"))
+    with _capture_warnings() as cap:
+        assert autotune.lookup("fused_ln", "rows256_h128",
+                               jnp.float32) is None
+        assert autotune.lookup("fused_ln", "rows256_h128",
+                               jnp.float32) is None
+    warns = [m for m in cap.messages()
+             if "different kernel source" in m]
+    assert len(warns) == 1
+
+
+def test_corrupt_table_degrades_with_single_warning(tmp_path):
+    (tmp_path / "table.json").write_text("{not json")
+    autotune.reset()
+    autotune.configure(table_path=str(tmp_path / "table.json"))
+    with _capture_warnings() as cap:
+        for _ in range(3):
+            assert autotune.lookup("fused_ln", "rows256_h128",
+                                   jnp.float32) is None
+    warns = [m for m in cap.messages() if "unreadable" in m]
+    assert len(warns) == 1
+    # and a later search repopulates it cleanly
+    res = _fake_search({256: 2e-3, 128: 1e-3})
+    assert res["params"] == {"row_block": 128}
+
+
+def test_version_stale_table_degrades(tmp_path):
+    doc = {"version": autotune.TABLE_VERSION + 1, "entries": {
+        "fused_ln|cpu|float32|rows256_h128": {
+            "params": {"row_block": 64}, "source_hash": "x"}}}
+    json.dump(doc, open(tmp_path / "table.json", "w"))
+    autotune.reset()
+    autotune.configure(table_path=str(tmp_path / "table.json"))
+    with _capture_warnings() as cap:
+        assert autotune.lookup("fused_ln", "rows256_h128",
+                               jnp.float32) is None
+    assert any("version" in m for m in cap.messages())
+
+
+def test_disabled_lookups_return_none(tmp_path):
+    _fake_search({256: 2e-3, 128: 1e-3})
+    autotune.configure(enabled=False)
+    assert autotune.lookup("fused_ln", "rows256_h128",
+                           jnp.float32) is None
+    autotune.configure(enabled=True)
+    assert autotune.lookup("fused_ln", "rows256_h128",
+                           jnp.float32) == {"row_block": 128}
+
+
+# ----------------------------------------------------------------------
+# monitor events
+# ----------------------------------------------------------------------
+def test_search_and_hit_events():
+    mon = _StubMonitor()
+    autotune.configure(monitor=mon)
+    _fake_search({256: 2e-3, 128: 1e-3})
+    kinds = [k for k, _ in mon.events]
+    assert kinds == ["autotune_search"]
+    _, fields = mon.events[0]
+    assert fields["kernel"] == "fused_ln"
+    assert fields["params"] == {"row_block": 128}
+    assert fields["speedup_vs_default"] == 2.0
+    # first lookup emits ONE autotune_hit; repeats stay silent
+    autotune.lookup("fused_ln", "rows256_h128", jnp.float32)
+    autotune.lookup("fused_ln", "rows256_h128", jnp.float32)
+    kinds = [k for k, _ in mon.events]
+    assert kinds == ["autotune_search", "autotune_hit"]
+
+
+# ----------------------------------------------------------------------
+# trace-time integration: the kernel entry points consult the table
+# ----------------------------------------------------------------------
+def test_fused_row_block_launcher_uses_tuned_value():
+    from deepspeed_tpu.ops.transformer import fused_ops
+    n, hp = 256, 128
+    sc = autotune.row_kernel_shape_class(n, hp)
+    assert fused_ops._tuned_row_block("fused_ln", n, hp,
+                                      jnp.float32) == 256  # default
+    autotune.record("fused_ln", sc, jnp.float32,
+                    {"row_block": 64}, 1.0, 2.0, 2, persist=False)
+    assert fused_ops._tuned_row_block("fused_ln", n, hp,
+                                      jnp.float32) == 64
+
+
+def test_flash_entry_point_resolves_tuned_blocks():
+    import importlib
+    fa = importlib.import_module(
+        "deepspeed_tpu.ops.transformer.flash_attention")
+    t, d = 512, 64
+    q = jnp.zeros((1, t, 1, d), jnp.float32)
+    sc = autotune.flash_shape_class(t, d, True, False)
+    autotune.record("flash_fwd", sc, jnp.float32,
+                    {"block_q": 128, "block_k": 256}, 1.0, 2.0, 2,
+                    persist=False)
+    args = fa._normalize_flash_args(q, q, q, True, None, None, None,
+                                    None)
+    assert (args[2], args[3]) == (128, 256)
+    # explicit caller blocks always win over the table — INCLUDING an
+    # explicit request for the default 1024/1024 shapes
+    args = fa._normalize_flash_args(q, q, q, True, None, 512, 512,
+                                    None)
+    assert (args[2], args[3]) == (512, 512)
+    args = fa._normalize_flash_args(q, q, q, True, None,
+                                    fa._DEFAULT_BLOCK,
+                                    fa._DEFAULT_BLOCK, None)
+    assert (args[2], args[3]) == (512, 512)   # _fit_block clamps to t
+
+
+def test_flash_lookup_rejects_incompatible_entries():
+    """A table entry whose blocks do not divide this trace's T falls
+    back to defaults instead of producing an illegal launch."""
+    t, d = 384, 64
+    sc = autotune.flash_shape_class(t, d, True, False)
+    autotune.record("flash_fwd", sc, jnp.float32,
+                    {"block_q": 256, "block_k": 256}, 1.0, 2.0, 2,
+                    persist=False)
+    assert autotune.flash_blocks(t, d, True, False,
+                                 jnp.float32) is None
+
+
+def test_qmm_blocks_lookup():
+    m, k, n = 2048, 1024, 4096
+    sc = autotune.qmm_shape_class(m, k, n)
+    assert autotune.qmm_blocks(m, k, n, jnp.bfloat16) is None
+    autotune.record("quantized_matmul", sc, jnp.bfloat16,
+                    {"block_m": 512, "block_n": 128}, 1.0, 2.0, 2,
+                    persist=False)
+    assert autotune.qmm_blocks(m, k, n, jnp.bfloat16) == (512, 128)
+
+
+def test_engine_configures_autotune(tmp_path):
+    """The `autotune` config block reaches ops.autotune at engine
+    init (path + enabled + monitor attach)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2ForCausalLM, \
+        tiny_gpt2_config
+    ids = np.zeros((8, 64), np.int32)
+    model = GPT2ForCausalLM(tiny_gpt2_config(n_positions=64))
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": ids})
+    table = str(tmp_path / "engine_table.json")
+    deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": 8,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "autotune": {"enabled": True, "table_path": table},
+        })
+    assert autotune.table_path() == table
+
+
+def test_shape_class_helpers():
+    assert autotune.pow2_bucket(1) == 1
+    assert autotune.pow2_bucket(200) == 256
+    assert autotune.flash_shape_class(1024, 64, True, True) == \
+        "t1024_d64_causal_packed"
+    assert autotune.row_kernel_shape_class(200, 128) == "rows256_h128"
+    assert {"block_q": 512, "block_k": 1024} in \
+        autotune.flash_block_candidates(1024)
+    assert all(1024 % c["block_q"] == 0
+               for c in autotune.flash_block_candidates(1024))

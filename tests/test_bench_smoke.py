@@ -334,6 +334,48 @@ def test_bench_only_serving_throughput_leg():
     assert result["continuous_vs_sequential_speedup"] >= 2.0, result
 
 
+def test_bench_only_quantized_matmul_leg():
+    """The quantized-compute GEMM A/B (ISSUE 13) via `--only`: parity
+    is hard-asserted INSIDE the leg (int8 GEMM vs f32 reference +
+    engine loss trajectory), so the smoke asserts the mechanism and a
+    catastrophic-regression bound only — the 1.15x speedup is an
+    environment-dependent contract flag on this shared box (the
+    numerics_overhead precedent)."""
+    proc = _bench_proc("--only", "quantized_matmul", timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "quantized_matmul"
+    result = d["result"]
+    assert "error" not in result, result
+    assert result["parity_ok"] is True, result
+    assert result["gemm_rel_err_vs_f32"] <= 0.05
+    assert result["engine_loss_max_abs_dev"] <= 0.2
+    assert result["bf16_gemm_ms"] > 0
+    assert result["quantized_gemm_ms"] > 0
+    assert "int8_faster" in result
+    # catastrophic bound: the int8 family must never be WAY slower
+    assert result["int8_speedup"] >= 0.5, result
+
+
+def test_bench_only_autotune_flash_leg():
+    """The flash block-size autotuner (ISSUE 13) via `--only`: the
+    search must complete, the winner must be >= 1.0x vs the
+    hand-picked defaults (never-slower by construction), and the
+    persisted table must reload across a process restart with the
+    traced entry point resolving the winning blocks."""
+    proc = _bench_proc("--only", "autotune_flash", timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "autotune_flash"
+    result = d["result"]
+    assert "error" not in result, result
+    assert result["never_slower"] is True, result
+    assert result["speedup_vs_default"] >= 1.0
+    assert result["reloaded_across_restart"] is True
+    assert result["candidates_tried"] >= 2
+    assert len(result["winning_blocks"]) == 2
+
+
 def test_bench_only_unknown_leg_fails_with_list():
     proc = _bench_proc("--only", "no_such_leg")
     assert proc.returncode != 0
