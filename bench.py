@@ -1829,7 +1829,7 @@ def bench_monitor_overhead():
     from deepspeed_tpu import initialize
 
     batch, seq = 8, 64
-    steps, warmup, windows = 20, 5, 10
+    steps, warmup, windows, repetitions = 20, 5, 6, 3
     cfg = tiny_gpt2_config(n_positions=seq, dropout=0.0)
     tmp = tempfile.mkdtemp(prefix="ds_monitor_bench_")
 
@@ -1880,17 +1880,28 @@ def bench_monitor_overhead():
         # a median of the per-pair ratios: load drift on a shared box
         # moves both legs of a pair together and the alternation
         # cancels any first-vs-second systematic, so the ratio stays
-        # clean where best-of-N absolute times do not
+        # clean where best-of-N absolute times do not. Each pair is
+        # additionally the MEDIAN of N=3 repetitions (the PR-13
+        # peak-probe discipline): a single scheduler hiccup landing
+        # inside one arm of one pair flaked this leg at PR-13 seed —
+        # the per-window median absorbs it, and the leg's verdict
+        # (`regressed`) only ever reads medians, never a raw window.
         times = {"off": [], "on": []}
         ratios = []
         for w in range(windows):
-            order = ("off", "on") if w % 2 == 0 else ("on", "off")
-            t = {}
-            for name in order:
-                t[name] = window(engines[name], 1000 + w * steps)
-            times["off"].append(t["off"])
-            times["on"].append(t["on"])
-            ratios.append(t["on"] / t["off"])
+            reps = []
+            for rep in range(repetitions):
+                order = ("off", "on") if (w + rep) % 2 == 0 \
+                    else ("on", "off")
+                t = {}
+                for name in order:
+                    t[name] = window(
+                        engines[name],
+                        1000 + (w * repetitions + rep) * steps)
+                times["off"].append(t["off"])
+                times["on"].append(t["on"])
+                reps.append(t["on"] / t["off"])
+            ratios.append(float(np.median(reps)))
 
         best = {k: min(v) for k, v in times.items()}
         out = {
@@ -1903,6 +1914,8 @@ def bench_monitor_overhead():
         }
         overhead = (float(np.median(ratios)) - 1.0) * 100.0
         out["overhead_pct"] = round(overhead, 2)
+        out["window_repetitions"] = repetitions
+        out["windows_measured"] = len(ratios)
         out["regressed"] = bool(overhead >= 3.0)
         snap = engines["on"].monitor.snapshot()
         # the proof the sink actually recorded the run: parse it back
@@ -2426,6 +2439,211 @@ def bench_serving_throughput():
     }
 
 
+def bench_serving_observability():
+    """Serving-observability overhead + fidelity A/B (ISSUE 14): the
+    PR-12 Poisson-arrival serving leg re-run with the request-lifecycle
+    tracker ON vs OFF — monitor + jsonl sink + trace export enabled in
+    BOTH legs, `inference.observability.enabled` toggled, so the ratio
+    isolates the TRACKER (monitor_overhead already prices the monitor
+    itself; the numerics_overhead discipline) — same engine config,
+    same arrival stream. The tracker
+    shares the monitor's <3% overhead contract: per-fence cost is host
+    dict/timestamp arithmetic plus one JSONL write — `regressed` is
+    the recorded contract flag, computed as a median of paired
+    order-alternating throughput ratios with adaptive extension (the
+    numerics_overhead discipline for environment-dependent ratios on
+    a shared box). Hard-asserted instead (they are deterministic up to
+    histogram bucket width): the tracker's reported p50/p99 TTFT and
+    per-token latency must agree with the leg's OWN independently
+    computed per-request latencies (from the Request result stamps the
+    scheduler fills, a separate code path and clock chain) within one
+    histogram bucket (the fixed log-spaced edges quantize at 2^(1/3)
+    ≈ 1.26x; asserted at 1.45x for clock-jitter headroom), and the
+    exported trace must carry the per-slot serving timeline + counter
+    tracks with a working `ds_trace summary --serving` view."""
+    import shutil
+    import tempfile
+    from deepspeed_tpu.inference import (InferenceEngine, Request,
+                                         ServingLoop)
+    from deepspeed_tpu.models.gpt2 import (GPT2ForCausalLM,
+                                           tiny_gpt2_config)
+    from deepspeed_tpu.monitor.trace_export import (load_trace,
+                                                    summarize_trace)
+
+    cfg = tiny_gpt2_config()
+    model = GPT2ForCausalLM(cfg)
+    r = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)})
+    inf_cfg = {"max_slots": 8, "prefill_chunk": 16, "sync_every": 8,
+               "max_new_tokens": 32,
+               "kv_cache": {"num_pages": 96, "page_size": 8}}
+    tmp = tempfile.mkdtemp(prefix="ds_serving_obs_bench_")
+
+    def build(obs_on):
+        # monitor ON in BOTH legs (the numerics_overhead discipline:
+        # monitor_overhead already prices the monitor itself) — the
+        # A/B isolates the TRACKER: inference.observability toggled
+        config = {
+            "inference": dict(
+                inf_cfg, observability={"enabled": obs_on}),
+            "monitor": {
+                "enabled": True, "sinks": ["jsonl"],
+                "output_path": tmp,
+                "job_name": "on" if obs_on else "off",
+                "trace": {"enabled": True}}}
+        return InferenceEngine(cfg, params, config)
+
+    # the PR-12 Poisson stream, identical across every run of each leg
+    n_req = 32
+    gaps = r.exponential(scale=0.004, size=n_req)
+    arrivals = np.cumsum(gaps)
+    lens = r.randint(4, 29, size=n_req)
+    news = r.randint(16, 33, size=n_req)
+    prompts = [r.randint(0, cfg.vocab_size,
+                         size=int(l)).astype(np.int32) for l in lens]
+
+    def make_requests():
+        return [Request(rid=i, tokens=prompts[i].copy(),
+                        max_new_tokens=int(news[i]),
+                        arrival_time=float(arrivals[i]))
+                for i in range(n_req)]
+
+    def run_leg(eng, collect=None):
+        eng.reset()
+        loop = ServingLoop(eng)
+        loop.serve(make_requests())
+        tokens = int(sum(len(q.out_tokens) for q in loop.results))
+        wall = max(q.finished_at for q in loop.results)
+        if collect is not None:
+            collect.extend(loop.results)
+        return tokens / wall
+
+    out = {}
+    try:
+        engines = {"off": build(False), "on": build(True)}
+        assert engines["off"].tracker is None
+        assert engines["on"].tracker is not None
+        # warmup settles donation/layouts (one request per engine).
+        # The ON warmup request lands in the tracker's cumulative
+        # histograms but not in the independent sample below — one
+        # 4-token request against the >=128 collected ones shifts a
+        # percentile by well under one histogram bucket.
+        for name in ("off", "on"):
+            ServingLoop(engines[name]).serve(
+                [Request(rid="w", tokens=prompts[0].copy(),
+                         max_new_tokens=4)])
+        on_requests = []
+        ratios = []
+
+        def run_pairs(n):
+            for _ in range(n):
+                # len(ratios) is the global pair counter, so the order
+                # genuinely alternates across the adaptive extension
+                order = ("off", "on") if len(ratios) % 2 == 0 \
+                    else ("on", "off")
+                tps = {}
+                for name in order:
+                    tps[name] = run_leg(
+                        engines[name],
+                        collect=on_requests if name == "on" else None)
+                ratios.append(tps["off"] / tps["on"])
+
+        run_pairs(4)
+        med = float(np.median(ratios))
+        if 1.5 <= (med - 1.0) * 100.0 <= 4.5:
+            # median inside the noise band of the 3% line: extend the
+            # sample instead of flaking either way
+            run_pairs(4)
+        overhead = (float(np.median(ratios)) - 1.0) * 100.0
+        out = {
+            "model": "gpt2-tiny", "requests": n_req,
+            "poisson_mean_interarrival_ms": 4.0,
+            "pairs_measured": len(ratios),
+            "overhead_pct": round(overhead, 2),
+            "regressed": bool(overhead >= 3.0),
+        }
+
+        # -- percentile fidelity: tracker histograms vs the leg's own
+        # independently computed per-request latencies --------------
+        trk = engines["on"].tracker
+        # the warmup request is in the hists; fold its stamps in too
+        # (its Request object was not collected — recompute from the
+        # tracker-side totals is NOT independent, so instead serve the
+        # comparison over collected runs only after priming both
+        # sides equally: the single 4-token warmup request shifts a
+        # >=128-sample distribution by well under one bucket)
+        ttft_exact = sorted(
+            (q.first_token_at - q.admitted_at) * 1e3
+            for q in on_requests if q.first_token_at is not None)
+        token_pairs = []
+        for q in on_requests:
+            n = max(len(q.out_tokens), 1)
+            live = q.live_at if q.live_at is not None else q.admitted_at
+            token_pairs.extend([(q.finished_at - live) * 1e3 / n] * n)
+        token_exact = sorted(token_pairs)
+
+        def pick(vals, p):
+            return vals[min(int(p * len(vals)), len(vals) - 1)]
+
+        def agree(reported, exact, band=1.45):
+            if reported is None or exact <= 0:
+                return False
+            return 1.0 / band <= reported / exact <= band
+
+        checks = {
+            "ttft_p50": (trk.hist_ttft_ms.percentile(0.50),
+                         pick(ttft_exact, 0.50)),
+            "ttft_p99": (trk.hist_ttft_ms.percentile(0.99),
+                         pick(ttft_exact, 0.99)),
+            "token_p50": (trk.hist_token_ms.percentile(0.50),
+                          pick(token_exact, 0.50)),
+            "token_p99": (trk.hist_token_ms.percentile(0.99),
+                          pick(token_exact, 0.99)),
+        }
+        for name, (rep, exact) in checks.items():
+            out[f"{name}_ms"] = None if rep is None else round(rep, 3)
+            out[f"{name}_exact_ms"] = round(exact, 3)
+            out[f"{name}_agree"] = agree(rep, exact)
+            assert out[f"{name}_agree"], \
+                (name, rep, exact, "tracker percentile diverged from " \
+                 "the independently computed request latencies")
+
+        # -- the trace contract: per-slot tracks, counter tracks, and
+        # the --serving summary view --------------------------------
+        path = engines["on"].monitor.export_trace()
+        doc = load_trace(path)
+        track_names = {ev["args"]["name"]
+                       for ev in doc["traceEvents"] if ev["ph"] == "M"}
+        slot_tracks = sorted(n for n in track_names
+                             if n.startswith("serve/slot"))
+        counter_names = {ev["name"] for ev in doc["traceEvents"]
+                         if ev["ph"] == "C"}
+        summary = summarize_trace(doc).get("serving") or {}
+        out["slot_tracks"] = len(slot_tracks)
+        out["counter_tracks_ok"] = bool(
+            {"queue_depth", "batch_occupancy", "kv_page_utilization",
+             "tokens_per_sec"} <= counter_names)
+        out["summary_requests"] = summary.get("requests", 0)
+        out["summary_serving_ok"] = bool(
+            summary.get("requests", 0) >= n_req and
+            summary.get("ttft_ms", {}).get("p50") is not None and
+            summary.get("token_ms", {}).get("p99") is not None)
+        assert out["slot_tracks"] >= 1, "no per-slot serving track"
+        assert out["counter_tracks_ok"], sorted(counter_names)
+        assert out["summary_serving_ok"], summary
+        # the SLO event stream flowed
+        jsonl = os.path.join(tmp, "on", "events.jsonl")
+        out["jsonl_serving_slo_events"] = sum(
+            1 for line in open(jsonl)
+            if json.loads(line).get("kind") == "serving_slo")
+        assert out["jsonl_serving_slo_events"] > 0
+        engines["on"].monitor.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # Named bench legs (single source for both `--only` and the full-suite
 # extras; each returns one JSON-able dict). Order matters: the full
 # suite runs the TPU legs in this order, then the memory plan.
@@ -2741,6 +2959,7 @@ BENCH_LEGS = {
     "zero3_overlap": bench_zero3_overlap,
     "elastic_recovery": bench_elastic_recovery,
     "serving_throughput": bench_serving_throughput,
+    "serving_observability": bench_serving_observability,
     "quantized_matmul": bench_quantized_matmul,
     "autotune_flash": bench_autotune_flash,
 }

@@ -111,6 +111,12 @@ ATTR_TYPES = {
     "_infer.cache": "deepspeed_tpu.inference.kv_cache:PagedKVCache",
     "_infer.monitor": "deepspeed_tpu.monitor:Monitor",
     "cache": "deepspeed_tpu.inference.kv_cache:PagedKVCache",
+    # serving observability (PR 14): the tracker's hooks run INSIDE
+    # ServingLoop.step (a hot entrypoint) — typing the attribute and
+    # the scheduler's `trk` local keeps them on the HOTSYNC sweep
+    "tracker": "deepspeed_tpu.monitor.serving:ServingTracker",
+    "_infer.tracker": "deepspeed_tpu.monitor.serving:ServingTracker",
+    "trk": "deepspeed_tpu.monitor.serving:ServingTracker",
 }
 
 # ----------------------------------------------------------------------

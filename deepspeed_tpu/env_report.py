@@ -209,6 +209,15 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("inference engine", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.monitor.serving import ServingTracker  # noqa: F401,E501
+        rows.append((
+            "serving observability",
+            f"{SUCCESS} per-request lifecycle traces, SLO "
+            "histograms, serving forensics (inference.observability; "
+            "ds_trace summary --serving)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("serving observability", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.analysis.rules import ALL_RULES
         from deepspeed_tpu.analysis import baseline as _bl
         bl_path = _bl.default_path(os.path.dirname(

@@ -11,6 +11,11 @@
   * InferenceConfig (config.py): the `inference` config block.
   * int8 weight-only quantization (quant.py): per-block-scale
     kernels quantized once at load, dequant-in-matmul epilogue.
+  * serving observability (monitor/serving.py, ISSUE 14): with a
+    `monitor` block enabled, a ServingTracker stamps each request's
+    lifecycle at the serving fences — per-slot Perfetto timeline,
+    per-fence `serving_slo` SLO events, live request table in flight
+    dumps (`inference.observability`; docs/monitoring.md).
 """
 
 from deepspeed_tpu.inference.config import (InferenceConfig,

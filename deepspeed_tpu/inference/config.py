@@ -10,6 +10,9 @@
                    "seed": 0,
                    "weight_bits": 32,
                    "weight_quant_block": 64,
+                   "observability": {"enabled": true,
+                                     "slo_ttft_ms": 0,
+                                     "slo_token_ms": 0},
                    "kv_cache": {"num_pages": 256, "page_size": 16}}}
 
 See the key-by-key commentary in runtime/constants.py (the
@@ -40,6 +43,19 @@ def _pos_int(block, key, default, dotted, minimum=1):
     if v < minimum:
         raise InferenceConfigError(
             f"{dotted} must be >= {minimum}, got {v}")
+    return v
+
+
+def _nonneg_float(block, key, default, dotted):
+    v = get_scalar_param(block, key, default)
+    try:
+        v = float(v)
+    except (TypeError, ValueError):
+        raise InferenceConfigError(
+            f"{dotted} must be a number, got {v!r}")
+    if v < 0:
+        raise InferenceConfigError(
+            f"{dotted} must be >= 0 (0 = no target), got {v}")
     return v
 
 
@@ -93,6 +109,21 @@ class InferenceConfig:
             block, C.INFERENCE_WEIGHT_QUANT_BLOCK,
             C.INFERENCE_WEIGHT_QUANT_BLOCK_DEFAULT,
             "inference.weight_quant_block")
+
+        obs = block.get(C.INFERENCE_OBSERVABILITY, {})
+        if not isinstance(obs, dict):
+            raise InferenceConfigError(
+                f'"inference.observability" must be a dict, got {obs!r}')
+        self.observability_enabled = bool(get_scalar_param(
+            obs, C.INFERENCE_OBS_ENABLED, C.INFERENCE_OBS_ENABLED_DEFAULT))
+        self.slo_ttft_ms = _nonneg_float(
+            obs, C.INFERENCE_OBS_SLO_TTFT_MS,
+            C.INFERENCE_OBS_SLO_TTFT_MS_DEFAULT,
+            "inference.observability.slo_ttft_ms")
+        self.slo_token_ms = _nonneg_float(
+            obs, C.INFERENCE_OBS_SLO_TOKEN_MS,
+            C.INFERENCE_OBS_SLO_TOKEN_MS_DEFAULT,
+            "inference.observability.slo_token_ms")
 
         kv = block.get(C.INFERENCE_KV_CACHE, {})
         if not isinstance(kv, dict):

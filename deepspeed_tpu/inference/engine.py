@@ -185,6 +185,15 @@ class InferenceEngine:
         self.monitor.ledger.register_tree(
             memory_mod.CAT_PARAMS, "inference.params", params)
 
+        # request-level serving observability (ISSUE 14): the tracker
+        # follows the monitor.flight convention — on by default, but
+        # only when a monitor block is enabled on the same config
+        self.tracker = None
+        if self.monitor.enabled and cfg.observability_enabled:
+            from deepspeed_tpu.monitor.serving import ServingTracker
+            self.tracker = ServingTracker(self.monitor, self.cache, cfg)
+            self.monitor.attach_serving(self.tracker)
+
         self._tables_version = self.cache.table_version
         self._state = self._fresh_state()
         self._decode = self._build_decode_step()
@@ -223,6 +232,8 @@ class InferenceEngine:
             self.cache.free(slot)
         self._state = self._fresh_state()
         self._tables_version = self.cache.table_version
+        if self.tracker is not None:
+            self.tracker.on_reset()
 
     # ------------------------------------------------------------------
     # the two AOT programs

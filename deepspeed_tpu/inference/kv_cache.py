@@ -123,6 +123,19 @@ class PagedKVCache:
     def allocated_bytes(self):
         return sum(len(p) for p in self._pages.values()) * self.page_bytes
 
+    def pages_in_use(self):
+        """Pages currently ASSIGNED to live requests (reservations not
+        yet backed by a page don't count — they are promises, not
+        bytes in a table row)."""
+        return sum(len(p) for p in self._pages.values())
+
+    def utilization(self):
+        """Assigned fraction of the allocatable pool (page 0 is
+        scratch) — the serving tracker's KV-utilization counter track
+        derives the same number from the ledger's `kv_cache` category;
+        this is the cache-side twin for tests and hints."""
+        return self.pages_in_use() / max(self.num_pages - 1, 1)
+
     # -- admission / growth / release -----------------------------------
     def can_admit(self, n_tokens_worst_case):
         """True when a request that may grow to n_tokens_worst_case

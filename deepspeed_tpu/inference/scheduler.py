@@ -48,6 +48,7 @@ class Request:
     out_tokens: Optional[np.ndarray] = None
     finish_reason: Optional[str] = None
     admitted_at: Optional[float] = None
+    live_at: Optional[float] = None     # prompt fully cached, decoding
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
 
@@ -71,9 +72,22 @@ class ServingLoop:
         # fence (decode grows it by at most sync_every between fences
         # — the per-block capacity ensure covers exactly that window)
         self._last_pos = np.zeros((engine.config.max_slots,), np.int64)
+        # host dispatch stamp of the current decode block (the serving
+        # tracker's per-fence decode window; None = no block in flight)
+        self._decode_t0 = None
 
     # -- submission -----------------------------------------------------
     def submit(self, req):
+        try:
+            self._check_submit(req)
+        except ValueError:
+            trk = self._infer.tracker
+            if trk is not None:
+                trk.on_rejected()
+            raise
+        self.queue.append(req)
+
+    def _check_submit(self, req):
         req.tokens = np.asarray(req.tokens, np.int32).reshape(-1)
         if len(req.tokens) < 1:
             raise ValueError(f"request {req.rid!r}: empty prompt")
@@ -107,7 +121,6 @@ class ServingLoop:
                 f"request {req.rid!r}: top_k {req.top_k} exceeds the "
                 "compiled sampling cap inference.top_k_max="
                 f"{self._infer.config.top_k_max}")
-        self.queue.append(req)
 
     def serve(self, requests, clock_zero=None):
         """Submit `requests` and run until everything finished.
@@ -126,7 +139,15 @@ class ServingLoop:
             else time.monotonic()
         self._last_fence_t = self._now()
         while self.queue or self.live or self.prefilling:
-            progressed = self.step()
+            try:
+                progressed = self.step()
+            except Exception as exc:
+                # serving forensics: the crash guard the training loop
+                # has had since PR 7 — the flight dump (with the live
+                # request table in its sticky context) survives the
+                # process; the exception still propagates
+                self._infer.monitor.on_crash(exc)
+                raise
             if not progressed:
                 # idle: everything queued is in the future
                 time.sleep(0.0005)
@@ -146,7 +167,10 @@ class ServingLoop:
                     slot, int(self._last_pos[slot]),
                     self._infer.config.sync_every)
             self._infer.push_tables()
+            self._decode_t0 = time.perf_counter()
             self._infer.decode_block(self._infer.config.sync_every)
+        else:
+            self._decode_t0 = None
         self._fence(self._infer.config.sync_every if self.live else 0)
         return True
 
@@ -164,6 +188,7 @@ class ServingLoop:
         big request is not starved by smaller later ones."""
         free = self._free_slots()
         future = []
+        trk = self._infer.tracker
         while free and self.queue:
             req = self.queue.popleft()
             if req.arrival_time > now:
@@ -173,18 +198,28 @@ class ServingLoop:
             if not self._infer.cache.can_admit(worst):
                 # pages exhausted: wait for an eviction
                 self.queue.appendleft(req)
+                if trk is not None:
+                    trk.on_admission_deferred()
                 break
             slot = free.pop(0)
             self._infer.cache.admit(slot, worst, name=str(req.rid))
             req.admitted_at = now
             self.prefilling[slot] = [req, 0]
+            pages_reserved = self._infer.cache.pages_for_tokens(worst)
+            if trk is not None:
+                trk.on_admitted(
+                    slot, str(req.rid), len(req.tokens),
+                    req.max_new_tokens,
+                    queued_s=max(now - req.arrival_time, 0.0),
+                    pages_reserved=pages_reserved)
             self._infer.monitor.event(
                 "request_admitted",
                 request_id=str(req.rid), slot=int(slot),
                 prompt_tokens=int(len(req.tokens)),
                 max_new_tokens=int(req.max_new_tokens),
                 queue_depth=len(self.queue),
-                queued_ms=round((now - req.arrival_time) * 1e3, 3))
+                queued_ms=round((now - req.arrival_time) * 1e3, 3),
+                kv_pages_reserved=int(pages_reserved))
         # not-yet-arrived requests go back in their original order
         for req in reversed(future):
             self.queue.appendleft(req)
@@ -194,6 +229,7 @@ class ServingLoop:
         live — the chunk granularity is what interleaves long prompts
         with the decode batch."""
         chunk = self._infer.config.prefill_chunk
+        trk = self._infer.tracker
         for slot in list(self.prefilling):
             req, start = self.prefilling[slot]
             t = len(req.tokens)
@@ -204,8 +240,12 @@ class ServingLoop:
                 # device table upload happens once per iteration in
                 # step() (push_tables dedupes by version anyway)
                 self._infer.cache.ensure(slot, end)
+                t0 = time.perf_counter()
                 self._infer.prefill_chunk(slot, req.tokens[start:end],
                                           start)
+                if trk is not None:
+                    trk.on_prefill_chunk(
+                        slot, t0, time.perf_counter() - t0, start, end)
                 self.prefilling[slot][1] = end
                 start = end
             if start >= n_prefill:
@@ -214,27 +254,43 @@ class ServingLoop:
                 self._infer.activate_slot(
                     slot, req.tokens[-1], t - 1, req.max_new_tokens,
                     req.temperature, req.top_k, req.eos_token_id)
+                req.live_at = self._now()
                 self.live[slot] = req
                 self._last_pos[slot] = t - 1
                 del self.prefilling[slot]
+                if trk is not None:
+                    trk.on_live(slot)
 
     def _fence(self, iterations):
         """The serving rendezvous: one device_get via
-        engine.fetch_state, then eviction + events (host-only work)."""
+        engine.fetch_state, then eviction + events (host-only work —
+        the tracker hooks are host dict/timestamp arithmetic; the
+        sync-guard tests run with the tracker ENABLED)."""
         snap = self._infer.fetch_state()
         now = self._now()
         window_s = max(now - self._last_fence_t, 1e-9)
+        trk = self._infer.tracker
         new_tokens = 0
+        deltas = {}
+        finished = []
         for slot, req in list(self.live.items()):
             gen = int(snap["n_gen"][slot])
             delta = gen - int(self._last_n_gen[slot])
+            deltas[slot] = delta
             new_tokens += delta
             if delta > 0 and req.first_token_at is None:
                 req.first_token_at = now
             self._last_pos[slot] = int(snap["pos"][slot])
             self._last_n_gen[slot] = gen
             if not snap["active"][slot]:
-                self._finish(slot, req, snap, now)
+                finished.append((slot, req))
+        if trk is not None:
+            # TTFT + per-slot decode windows BEFORE evictions, so a
+            # request that got its first token and finished inside the
+            # same window still records both
+            trk.on_fence_progress(self._decode_t0, iterations, deltas)
+        for slot, req in finished:
+            self._finish(slot, req, snap, now)
         if new_tokens > 0:
             self.token_latencies.extend(
                 [window_s / new_tokens] * new_tokens)
@@ -246,12 +302,17 @@ class ServingLoop:
             active_slots=len(self.live),
             prefilling_slots=len(self.prefilling),
             queue_depth=len(self.queue),
+            window_ms=round(window_s * 1e3, 3),
             window_tokens=int(new_tokens),
             tokens_per_sec=round(new_tokens / window_s, 3),
-            kv_pages_in_use=int(
-                self._infer.cache.allocated_bytes() //
-                self._infer.cache.page_bytes),
+            kv_pages_in_use=int(self._infer.cache.pages_in_use()),
             kv_pages_free=int(self._infer.cache.free_pages()))
+        if trk is not None:
+            # SLO metrics AFTER evictions: this fence's finishes are in
+            # the histograms/counters the event reports
+            trk.on_fence_metrics(window_s, new_tokens,
+                                 len(self.queue), len(self.live),
+                                 len(self.prefilling))
         if mon.memory_enabled:
             mon._emit_memory_event(self._infer._host_steps)
 
@@ -265,9 +326,17 @@ class ServingLoop:
         del self.live[slot]
         self._last_n_gen[slot] = 0
         self._last_pos[slot] = 0
+        trk = self._infer.tracker
+        if trk is not None:
+            # before cache.free: the tracker's final row keeps the
+            # pages the request held when it finished
+            trk.on_finished(slot, req.finish_reason)
         self._infer.cache.free(slot)
         self.results.append(req)
         wall_s = max(now - req.admitted_at, 1e-9)
+        live_at = req.live_at if req.live_at is not None \
+            else req.admitted_at
+        decode_s = max(now - live_at, 1e-9)
         self._infer.monitor.event(
             "request_finished",
             request_id=str(req.rid), slot=int(slot),
@@ -278,6 +347,10 @@ class ServingLoop:
                 (req.admitted_at - req.arrival_time) * 1e3, 3),
             ttft_ms=None if req.first_token_at is None else round(
                 (req.first_token_at - req.admitted_at) * 1e3, 3),
+            prefill_ms=round(max(live_at - req.admitted_at, 0.0) * 1e3,
+                             3),
+            decode_ms=round(decode_s * 1e3, 3),
+            token_ms=round(decode_s * 1e3 / max(gen, 1), 3),
             wall_ms=round(wall_s * 1e3, 3),
             tokens_per_sec=round(gen / wall_s, 3))
 

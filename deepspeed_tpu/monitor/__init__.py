@@ -108,6 +108,7 @@ class Monitor:
         self._cum = {"steps": 0, "overflow_count": 0, "tokens": 0}
         self._last = {}          # most recent drained window metrics
         self._last_numerics = None
+        self._serving_ref = None     # live ServingTracker (serving)
         self._first_nonfinite = None   # sticky first-NaN attribution
         # host-side heartbeat mirror (ages for the flight recorder even
         # when no watchdog is configured)
@@ -213,6 +214,13 @@ class Monitor:
         self.ledger.register_dynamic(
             memory_mod.CAT_PREFETCH, "prefetch.staged",
             lambda: (lambda l: l.buffer_bytes() if l else 0)(ref()))
+
+    def attach_serving(self, tracker):
+        """Remember the live ServingTracker (monitor/serving.py) so
+        crash forensics can attach the in-flight request table and the
+        serving-aware OOM hint ranking. The tracker updates the flight
+        context itself at every phase change."""
+        self._serving_ref = weakref.ref(tracker)
 
     def heartbeat(self, source):
         self._hb[source] = time.monotonic()
@@ -571,6 +579,14 @@ class Monitor:
             return
         extra = {"error": repr(exc)}
         reason = "exception"
+        serving = self._serving_ref() if self._serving_ref else None
+        if serving is not None:
+            try:
+                # the in-flight request table: an OOM/crash dump names
+                # exactly which requests were being served
+                extra["serving"] = serving.snapshot()
+            except Exception:  # ds-lint: allow[BROADEXC] crash forensics must not mask the original exception mid-propagation
+                serving = None
         if self.memory_enabled and memory_mod.classify_oom(exc):
             reason = "oom"
             try:
@@ -582,12 +598,24 @@ class Monitor:
             except Exception:  # ds-lint: allow[BROADEXC] an OOM post-mortem must never raise while handling the original failure
                 payload = self._last_memory or \
                     self.ledger.reconcile(None, None)
+            hints = memory_mod.oom_hints(payload)
+            if serving is not None:
+                try:
+                    from deepspeed_tpu.monitor.serving import \
+                        serving_oom_hints
+                    # serving-aware ranking FIRST: on a serving engine
+                    # the kv_cache / max_slots / prefill_chunk knobs
+                    # are the ones the operator can actually turn
+                    hints = serving_oom_hints(
+                        payload, extra.get("serving")) + hints
+                except Exception:  # ds-lint: allow[BROADEXC] an OOM post-mortem must never raise while handling the original failure
+                    pass
             extra["oom"] = {
                 "hbm": payload.get("hbm"),
                 "host": payload.get("host"),
                 "peak": payload.get("peak"),
                 "top_buffers": payload.get("top_buffers"),
-                "hints": memory_mod.oom_hints(payload),
+                "hints": hints,
             }
         if self.flight is not None:
             try:

@@ -226,6 +226,17 @@ class MemoryLedger:
         return [{"category": e["category"], "name": e["name"],
                  "space": e["space"], "bytes": b} for e, b in rows]
 
+    def category_breakdown(self, category, space=SPACE_HBM):
+        """{entry name: sampled bytes} for ONE category's live entries
+        (all of them — `top_buffers` truncates). The serving tracker
+        reads the `kv_cache` split (per-request entries vs
+        `pool.unallocated`) from here to derive page utilization."""
+        out = {}
+        for e, b in self._sampled():
+            if e["category"] == str(category) and e["space"] == space:
+                out[e["name"]] = out.get(e["name"], 0) + b
+        return out
+
     def set_plan(self, plan):
         """Attach a per-component memory plan ({component: planned
         bytes per device}, hbm space); `reconcile` reports

@@ -12,6 +12,11 @@ prints per-track busy/occupancy and — when pipeline events are present
 When the trace carries the memory ledger's counter tracks it also
 prints per-category last/peak bytes and — when a memory plan rode in
 the trace metadata — the per-component plan-vs-measured deltas.
+
+`summary --serving` restricts the output to the serving view: the
+per-request p50/p99 queue-wait / TTFT / per-token decode latency and
+goodput-vs-throughput, recomputed from the `serving_request` finish
+instants the ServingTracker stamps (monitor/serving.py).
 """
 
 import argparse
@@ -38,6 +43,15 @@ def _cmd_merge(args):
 def _cmd_summary(args):
     docs = [load_trace(p) for p in args.paths]
     doc = docs[0] if len(docs) == 1 else merge_traces(docs)
+    if getattr(args, "serving", False):
+        s = summarize_trace(doc)
+        serving = s.get("serving")
+        if not serving:
+            print("no serving events in trace (run with a monitor "
+                  "block + inference.observability enabled)")
+            return 1
+        _print_serving(serving)
+        return 0
     _print_summary(doc)
     return 0
 
@@ -71,7 +85,10 @@ def _print_summary(doc):
     mem = s.get("memory")
     if mem:
         _print_memory(mem)
-    if not tracks and not pipe and not mem:
+    serving = s.get("serving")
+    if serving:
+        _print_serving(serving)
+    if not tracks and not pipe and not mem and not serving:
         print("no complete events in trace")
 
 
@@ -110,6 +127,30 @@ def _print_memory(mem):
                   f"{delta:>9}")
 
 
+def _print_serving(s):
+    """Per-request serving stats recomputed from the `serving_request`
+    finish instants (fence-granularity host stamps — see
+    docs/inference.md "Observability")."""
+    print("serving (per-request, fence granularity):")
+    good = s.get("goodput_fraction")
+    share = s.get("queue_wait_share")
+    print(f"  requests={s['requests']} new_tokens={s['new_tokens']} "
+          f"goodput_tokens={s['goodput_tokens']}"
+          + ("" if good is None else f" goodput_fraction={good}")
+          + ("" if share is None else f" queue_wait_share={share}"))
+    print(f"  {'metric'.ljust(12)}  {'p50_ms':>9}  {'p99_ms':>9}")
+    for label, key in (("queue_wait", "queued_ms"),
+                       ("ttft", "ttft_ms"),
+                       ("token", "token_ms")):
+        row = s.get(key) or {}
+
+        def fmt(v):
+            return "-" if v is None else f"{v:.3f}"
+
+        print(f"  {label.ljust(12)}  {fmt(row.get('p50')):>9}  "
+              f"{fmt(row.get('p99')):>9}")
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="ds_trace",
@@ -122,6 +163,10 @@ def main(argv=None):
     s = sub.add_parser("summary",
                        help="per-track occupancy + pipeline bubble")
     s.add_argument("paths", nargs="+")
+    s.add_argument("--serving", action="store_true",
+                   help="per-request serving view: p50/p99 queue-wait/"
+                        "TTFT/per-token latency + goodput vs "
+                        "throughput")
     s.set_defaults(fn=_cmd_summary)
     args = parser.parse_args(argv)
     try:

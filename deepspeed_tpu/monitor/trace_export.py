@@ -51,6 +51,14 @@ CAT_SUBSYSTEM = "subsystem"
 CAT_PIPE_FWD = "pipe_fwd"
 CAT_PIPE_BWD = "pipe_bwd"
 CAT_MARK = "mark"
+# the serving timeline (monitor/serving.py, ISSUE 14): one track per
+# decode slot; queue-wait, prefill chunks and decode windows are
+# distinct slice types, and each finished request leaves one instant
+# carrying its lifecycle stats (the `ds_trace summary --serving` rows)
+CAT_SERVE_QUEUE = "serving_queue"
+CAT_SERVE_PREFILL = "serving_prefill"
+CAT_SERVE_DECODE = "serving_decode"
+CAT_SERVE_REQUEST = "serving_request"
 
 
 def analytic_bubble_fraction(stages, micro_batches, num_virtual_stages=1):
@@ -207,6 +215,13 @@ class TraceExporter:
                      **self._meta}
             if self._pipeline is not None:
                 other["pipeline"] = dict(self._pipeline)
+        # exported order is ts order (metadata first, like merge):
+        # some slices are stamped retroactively — the serving tracker
+        # back-dates a request's queue-wait to its arrival when the
+        # slot is granted — and the Chrome format (and our validator)
+        # wants per-track monotonic ts regardless of append order
+        events.sort(key=lambda e: (e.get("ph") != "M",
+                                   e.get("ts", 0)))
         return {"traceEvents": events, "displayTimeUnit": "ms",
                 "otherData": other}
 
@@ -270,11 +285,19 @@ def summarize_trace(doc):
     names = {}
     pipe_busy = {}
     mem_counters = {}   # series name -> {key: {"last", "peak"}}
+    serving_reqs = []   # args of serving_request finish instants
     for ev in doc.get("traceEvents", []):
         ph = ev.get("ph")
         if ph == "M" and ev.get("name") == "thread_name":
             names[(ev.get("pid"), ev.get("tid"))] = \
                 ev.get("args", {}).get("name")
+            continue
+        if ph in ("i", "I") and ev.get("cat") == CAT_SERVE_REQUEST:
+            # one instant per finished request, args = its lifecycle
+            # stats (monitor/serving.py) — the summary recomputes the
+            # percentiles FROM these, so merged/filtered traces still
+            # summarize honestly (the pipeline-bubble convention)
+            serving_reqs.append(ev.get("args") or {})
             continue
         if ph == "C" and ev.get("name") in ("hbm_bytes", "host_bytes"):
             # the memory ledger's per-category counter tracks, keyed
@@ -374,4 +397,52 @@ def summarize_trace(doc):
                      if k != "residual"}
             mem["plan_vs_measured"] = plan_vs_measured(plan, peaks)
         out["memory"] = mem
+    if serving_reqs:
+        out["serving"] = summarize_serving_requests(serving_reqs)
     return out
+
+
+def _weighted_percentile(pairs, p):
+    """Percentile over (value, weight) pairs (weight = token count for
+    per-token latencies; 1 for per-request stats). None when empty."""
+    pairs = sorted((float(v), max(int(w), 0)) for v, w in pairs
+                   if v is not None)
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        return None
+    target = p * total
+    acc = 0
+    for v, w in pairs:
+        acc += w
+        if acc >= target:
+            return v
+    return pairs[-1][0]
+
+
+def summarize_serving_requests(rows):
+    """Per-request serving stats from the `serving_request` finish
+    instants: p50/p99 queue-wait, TTFT and per-token decode latency
+    (token-weighted), plus goodput vs throughput (tokens from requests
+    that met every configured SLO target vs all tokens) and the
+    queue-wait share of end-to-end latency — the saturation signal."""
+    def pcts(key, weighted=False):
+        pairs = [(r.get(key), r.get("new_tokens", 1) if weighted else 1)
+                 for r in rows]
+        return {"p50": _weighted_percentile(pairs, 0.50),
+                "p99": _weighted_percentile(pairs, 0.99)}
+
+    tokens = sum(int(r.get("new_tokens") or 0) for r in rows)
+    goodput = sum(int(r.get("new_tokens") or 0) for r in rows
+                  if r.get("slo_ok"))
+    queued = sum(float(r.get("queued_ms") or 0.0) for r in rows)
+    e2e = queued + sum(float(r.get("wall_ms") or 0.0) for r in rows)
+    return {
+        "requests": len(rows),
+        "new_tokens": tokens,
+        "queued_ms": pcts("queued_ms"),
+        "ttft_ms": pcts("ttft_ms"),
+        "token_ms": pcts("token_ms", weighted=True),
+        "goodput_tokens": goodput,
+        "goodput_fraction": round(goodput / tokens, 4) if tokens else None,
+        "queue_wait_share": round(queued / e2e, 4) if e2e > 0 else None,
+    }

@@ -588,3 +588,22 @@ INFERENCE_KV_NUM_PAGES = "num_pages"
 INFERENCE_KV_NUM_PAGES_DEFAULT = 256
 INFERENCE_KV_PAGE_SIZE = "page_size"
 INFERENCE_KV_PAGE_SIZE_DEFAULT = 16
+#############################################
+# Serving observability (ISSUE 14, monitor/serving.py).
+# observability.enabled: build the per-request lifecycle tracker when
+#   a monitor block is enabled on the same config (default true; the
+#   monitor.flight / monitor.memory convention — no monitor, no
+#   tracker). The tracker stamps request phases from host dispatch
+#   timestamps at the existing serving fences only: zero new per-token
+#   host syncs (the HOTSYNC contract).
+# observability.slo_ttft_ms / slo_token_ms: latency targets for the
+#   goodput split (tokens from requests meeting every configured
+#   target vs all tokens). 0 = no target (goodput == throughput).
+#############################################
+INFERENCE_OBSERVABILITY = "observability"
+INFERENCE_OBS_ENABLED = "enabled"
+INFERENCE_OBS_ENABLED_DEFAULT = True
+INFERENCE_OBS_SLO_TTFT_MS = "slo_ttft_ms"
+INFERENCE_OBS_SLO_TTFT_MS_DEFAULT = 0.0
+INFERENCE_OBS_SLO_TOKEN_MS = "slo_token_ms"
+INFERENCE_OBS_SLO_TOKEN_MS_DEFAULT = 0.0
