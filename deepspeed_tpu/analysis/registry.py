@@ -60,6 +60,17 @@ HOT_ENTRYPOINTS = (
     "deepspeed_tpu.inference.engine:InferenceEngine.decode_block",
     "deepspeed_tpu.inference.engine:InferenceEngine.prefill_chunk",
     "deepspeed_tpu.inference.scheduler:ServingLoop.step",
+    # mixture-of-experts (PR 15): router + dispatch/combine + grouped
+    # GEMMs trace inside every MoE step — all trace-time graph
+    # construction (reductions, one-hots, einsums, sharding
+    # constraints); router stats stay device-side until the monitor
+    # fence, so none of these may sync
+    "deepspeed_tpu.moe.router:top_k_gating",
+    "deepspeed_tpu.moe.dispatch:dispatch_tokens",
+    "deepspeed_tpu.moe.dispatch:combine_tokens",
+    "deepspeed_tpu.moe.experts:grouped_gemm",
+    "deepspeed_tpu.moe.experts:ExpertFFN.__call__",
+    "deepspeed_tpu.moe.layer:MoEMLP.__call__",
 )
 
 # ----------------------------------------------------------------------

@@ -399,6 +399,16 @@ class ElasticSupervisor:
                     f"meshes; mesh.{axis}={mesh_block[axis]} is not "
                     "supported — run model/pipe-parallel jobs under "
                     "plain deepspeed_tpu.initialize()")
+        # a pinned `expert` axis (deepspeed_tpu/moe/) SURVIVES the
+        # re-form: the data axis absorbs the host loss, expert state
+        # re-plans onto the same expert-group count. The supervisor
+        # only re-forms worlds divisible by it (_select_world).
+        self._expert_axis = int(mesh_block.get("expert", 1))
+        if self._expert_axis < 1:
+            raise ElasticityConfigError(
+                f"mesh.expert must be >= 1, got {self._expert_axis}")
+        self._mesh_block = {"expert": self._expert_axis} \
+            if self._expert_axis > 1 else None
         self.model_factory = model_factory
         self.batch_fn = batch_fn
         self.injector = injector if injector is not None \
@@ -431,12 +441,15 @@ class ElasticSupervisor:
         return valid
 
     def _select_world(self, n_devices):
-        """Largest compatible device count <= the survivor count."""
-        valid = [g for g in self._valid_worlds() if g <= n_devices]
+        """Largest compatible device count <= the survivor count (and
+        divisible by a pinned expert axis, which the re-form keeps)."""
+        valid = [g for g in self._valid_worlds()
+                 if g <= n_devices and g % self._expert_axis == 0]
         if not valid:
             raise ElasticityIncompatibleWorldSize(
                 f"no compatible device count <= {n_devices} survivors "
-                f"(valid: {self._valid_worlds()}); cannot re-form")
+                f"(valid: {self._valid_worlds()}, expert axis "
+                f"{self._expert_axis}); cannot re-form")
         return max(valid)
 
     def _plan(self, world):
@@ -465,7 +478,7 @@ class ElasticSupervisor:
         world = self._select_world(len(devices))
         devices = list(devices)[:world]
         spec = self._plan(world)
-        mesh = reform_mesh(devices)
+        mesh = reform_mesh(devices, self._mesh_block)
         model, params = self.model_factory()
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, model_parameters=params,

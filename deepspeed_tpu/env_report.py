@@ -218,6 +218,15 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("serving observability", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.moe import MoEMLP  # noqa: F401
+        rows.append((
+            "mixture of experts",
+            f"{SUCCESS} expert-parallel top-k routing, all-to-all "
+            "dispatch, grouped-GEMM FFNs composed with ZeRO-3 + "
+            "elasticity (moe block + mesh expert axis; docs/moe.md)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("mixture of experts", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.analysis.rules import ALL_RULES
         from deepspeed_tpu.analysis import baseline as _bl
         bl_path = _bl.default_path(os.path.dirname(

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
-from deepspeed_tpu.runtime.mesh import MODEL_AXIS
+from deepspeed_tpu.runtime.mesh import EXPERT_AXIS, MODEL_AXIS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,12 +90,33 @@ class GPT2Config:
     sequence_parallel: Optional[str] = None   # None | "ring" | "ulysses"
     sp_mesh: Any = None
     sp_axis: str = "model"
+    # Mixture-of-Experts (deepspeed_tpu/moe/): a MoEConfig makes every
+    # `every_n_layers`-th block replace its dense MLP with the gated
+    # top-k expert-parallel MoE MLP (router + capacity-factor
+    # all-to-all dispatch + grouped-GEMM experts). STRUCTURAL — the
+    # parameter tree changes for MoE layers (dense layers keep the
+    # exact dense tree, so their weights load from dense
+    # checkpoints); None is bit-for-bit the dense model. The engine's
+    # `moe` config block wires the runtime knobs via `configure_moe`.
+    moe: Any = None
     initializer_range: float = 0.02
 
     @property
     def head_dim(self):
         assert self.n_embd % self.n_head == 0
         return self.n_embd // self.n_head
+
+    @property
+    def moe_cells(self):
+        """Scan length of the MoE super-cell stack: each cell holds
+        (every_n_layers - 1) dense blocks + one MoE block."""
+        assert self.moe is not None
+        every = self.moe.every_n_layers
+        if self.n_layer % every:
+            raise ValueError(
+                f"moe.every_n_layers={every} must divide n_layer="
+                f"{self.n_layer}")
+        return self.n_layer // every
 
 
 # Named model sizes (GPT-2 paper + GPT-3-style scale points used by the
@@ -369,6 +390,98 @@ class GPT2Block(nn.Module):
         return hidden + y
 
 
+class MoEGPT2Block(nn.Module):
+    """Pre-LN block whose MLP is the mixture-of-experts MoEMLP
+    (deepspeed_tpu/moe/layer.py): attention half IDENTICAL to
+    GPT2Block (same submodule names — ln_1/c_attn/c_proj/ln_2, so a
+    dense checkpoint's attention weights load into an MoE model's MoE
+    layers too), then router + dispatch + grouped-GEMM experts +
+    combine instead of c_fc/mlp_c_proj. Returns (hidden, stats) —
+    the [E+2] router stats vector the scan carry accumulates."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, hidden, deterministic: bool = True):
+        cfg = self.config
+        b, t, c = hidden.shape
+        from deepspeed_tpu.moe.layer import MoEMLP
+        from deepspeed_tpu.ops.transformer.quantized_matmul import \
+            resolve_quantized_compute
+        use_quant = resolve_quantized_compute(cfg.quantized_compute)
+
+        def proj(features, name, init_scale=1.0):
+            if use_quant:
+                return _quant_dense(features, cfg, name,
+                                    init_scale=init_scale)
+            return _dense(features, cfg, name, init_scale=init_scale)
+
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                           dtype=jnp.float32,
+                           param_dtype=cfg.param_dtype, name="ln_1")
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                           dtype=jnp.float32,
+                           param_dtype=cfg.param_dtype, name="ln_2")
+
+        x = ln1(hidden).astype(cfg.dtype)
+        qkv = proj(3 * cfg.n_embd, "c_attn")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, cfg.n_head, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_head, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_head, cfg.head_dim)
+        drop_rng = None
+        if not deterministic and cfg.dropout > 0.0:
+            drop_rng = self.make_rng("dropout")
+        attn = _attention(cfg, q, k, v, drop_rng, deterministic)
+        attn = attn.reshape(b, t, cfg.n_embd)
+        attn = proj(cfg.n_embd, "c_proj",
+                    init_scale=1.0 / np.sqrt(2 * cfg.n_layer))(attn)
+        attn = nn.Dropout(cfg.dropout)(attn, deterministic=deterministic)
+        hidden = hidden + attn
+
+        y = ln2(hidden).astype(cfg.dtype)
+        y, stats = MoEMLP(
+            moe=cfg.moe, d_model=cfg.n_embd, d_ff=4 * cfg.n_embd,
+            dtype=cfg.dtype, param_dtype=cfg.param_dtype,
+            kernel_init=nn.initializers.normal(cfg.initializer_range),
+            out_kernel_init=nn.initializers.normal(
+                cfg.initializer_range / np.sqrt(2 * cfg.n_layer)),
+            name="moe_mlp")(y, deterministic)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return hidden + y, stats
+
+
+class _MoECellScan(nn.Module):
+    """Scan cell of the MoE model: (every_n_layers - 1) dense
+    GPT2Blocks — parameter-tree-identical to the dense model's
+    blocks — followed by one MoEGPT2Block. Carry =
+    (hidden, stats_sum): router stats accumulate across cells on
+    device and surface once per step through the model loss, never
+    per-layer host traffic. Also the cell the ZeRO-3 scheduled path
+    applies per stacked slice (_zero3_loss), so the two traces run
+    the same op sequence."""
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, carry, deterministic):
+        cfg = self.config
+        hidden, stats = carry
+        block_cls = GPT2Block
+        moe_cls = MoEGPT2Block
+        if cfg.remat:
+            block_cls = nn.remat(GPT2Block, prevent_cse=False,
+                                 static_argnums=(2, 4),
+                                 policy=resolve_remat_policy(
+                                     cfg.remat_policy))
+            moe_cls = nn.remat(MoEGPT2Block, prevent_cse=False,
+                               static_argnums=(2,),
+                               policy=resolve_remat_policy(
+                                   cfg.remat_policy))
+        for _ in range(cfg.moe.every_n_layers - 1):
+            hidden = block_cls(cfg)(hidden, deterministic, None, False)
+        hidden, s = moe_cls(cfg)(hidden, deterministic)
+        return (hidden, stats + s), None
+
+
 def embed_tokens(cfg: GPT2Config, wte, wpe, input_ids):
     """Token + position embedding in the compute dtype — the ONE
     definition of GPT-2's embedding arithmetic, shared by the module
@@ -409,6 +522,44 @@ class GPT2LMHeadModel(nn.Module):
 
         hidden = embed_tokens(cfg, wte, wpe, input_ids)
         hidden = nn.Dropout(cfg.dropout)(hidden, deterministic=deterministic)
+
+        if cfg.moe is not None:
+            # MoE path: scan super-cells of (every_n - 1 dense blocks
+            # + 1 MoE block); the carry threads (hidden, router-stats
+            # sum) so per-layer stats reach the loss/monitor with zero
+            # extra host traffic. Boundary fusion and PLD keep to the
+            # dense path (the MoE combine boundary is not a fusable
+            # bias+residual chain).
+            if layer_keep_prob is not None:
+                raise ValueError(
+                    "progressive_layer_drop is not supported with "
+                    "mixture-of-experts (no per-cell keep-prob gate)")
+            cells = cfg.moe_cells
+            ScannedCells = nn.scan(
+                _MoECellScan,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True,
+                            "quant": True},
+                in_axes=(nn.broadcast,),
+                length=cells,
+                metadata_params={nn.meta.PARTITION_NAME: "layers"},
+            )
+            stats0 = jnp.zeros((cfg.moe.num_experts + 2,), jnp.float32)
+            (hidden, stats), _ = ScannedCells(cfg, name="h")(
+                (hidden, stats0), deterministic)
+            # per-MoE-layer mean: aux weighting and the fence event
+            # stay depth-independent
+            moe_stats = stats / jnp.float32(cells)
+            hidden = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                                  dtype=jnp.float32,
+                                  param_dtype=cfg.param_dtype,
+                                  name="ln_f")(hidden)
+            if return_hidden:
+                return (hidden.astype(cfg.dtype), wte), moe_stats
+            logits = jnp.einsum("btc,vc->btv",
+                                hidden.astype(cfg.dtype),
+                                wte.astype(cfg.dtype))
+            return logits, moe_stats
 
         # Scan one block over a stacked [n_layer, ...] param tree: single
         # trace, O(1) compile in depth, and the layer dim is what pipeline
@@ -584,6 +735,63 @@ class GPT2ForCausalLM:
         tree is IDENTICAL either way — checkpoints interchange."""
         self._zero3 = sched
 
+    def moe_info(self):
+        """Engine-facing MoE summary (None = dense model): the keys
+        the engine needs for the `moe` block verification, the router
+        labels of the per-fence `router` event, and the moe_dispatch
+        ledger multiplier."""
+        moe = self.config.moe
+        if moe is None:
+            return None
+        return dict(num_experts=moe.num_experts, top_k=moe.top_k,
+                    capacity_factor=moe.capacity_factor,
+                    aux_loss_weight=moe.aux_loss_weight,
+                    every_n_layers=moe.every_n_layers,
+                    jitter_eps=moe.jitter_eps,
+                    width=self.config.n_embd,
+                    moe_layers=self.config.moe_cells)
+
+    def configure_moe(self, mesh=None, num_experts=None,
+                      every_n_layers=None, top_k=None,
+                      capacity_factor=None, aux_loss_weight=None,
+                      jitter_eps=None):
+        """Engine hook for the `moe` config block. Structural keys
+        (num_experts, every_n_layers) are VERIFIED against the built
+        model — they shape the parameter tree, so a mismatch is a
+        config error, not a rebuild. Router knobs (top_k,
+        capacity_factor, aux_loss_weight, jitter_eps) and the engine
+        mesh are applied: they are trace-time behavior, the parameter
+        tree is identical before and after."""
+        moe = self.config.moe
+        if moe is None:
+            raise ValueError(
+                "moe config block is enabled but the model was built "
+                "without MoE structure; construct it with "
+                "GPT2Config(moe=MoEConfig(...)) so the parameter tree "
+                "carries the expert leaves")
+        for key, want in (("num_experts", num_experts),
+                          ("every_n_layers", every_n_layers)):
+            have = getattr(moe, key)
+            if want is not None and int(want) != have:
+                raise ValueError(
+                    f"moe.{key}={want} does not match the model's "
+                    f"built structure ({have}); structural keys "
+                    "cannot be reconfigured after init")
+        updates = {}
+        if mesh is not None:
+            updates["mesh"] = mesh
+        if top_k is not None:
+            updates["top_k"] = int(top_k)
+        if capacity_factor is not None:
+            updates["capacity_factor"] = float(capacity_factor)
+        if aux_loss_weight is not None:
+            updates["aux_loss_weight"] = float(aux_loss_weight)
+        if jitter_eps is not None:
+            updates["jitter_eps"] = float(jitter_eps)
+        moe = dataclasses.replace(moe, **updates).validate()
+        self.config = dataclasses.replace(self.config, moe=moe)
+        self.module = GPT2LMHeadModel(self.config)
+
     def configure_quantized_compute(self, mode, block=None,
                                     stochastic_rounding=None):
         """Engine hook for the `quantized_compute` config block:
@@ -620,15 +828,33 @@ class GPT2ForCausalLM:
         return input_ids, labels
 
     _zero3_dropout_warned = False
+    _zero3_jitter_warned = False
 
     def _zero3_active(self, deterministic):
-        """Scheduled-path gate: dropout-active traces stay on the
+        """Scheduled-path gate: rng-consuming traces stay on the
         module path — the scheduled stack folds its own per-layer rng
-        stream, which would silently change dropout masks vs the
-        module path (and false-alarm an ABCorrectnessChecker A/B).
-        The fused_ops/head_packing "auto = dropout-inactive"
-        convention, applied to the gather schedule."""
+        stream, which would silently change dropout masks (and MoE
+        router-jitter draws) vs the module path, false-alarming an
+        ABCorrectnessChecker A/B. The fused_ops/head_packing
+        "auto = dropout-inactive" convention, applied to the gather
+        schedule; moe.jitter_eps is the same kind of training-only
+        rng consumer, so it gates identically."""
         if self._zero3 is None:
+            return False
+        jitter_active = (not deterministic and
+                         self.config.moe is not None and
+                         self.config.moe.jitter_eps > 0.0)
+        if jitter_active:
+            if not GPT2ForCausalLM._zero3_jitter_warned:
+                GPT2ForCausalLM._zero3_jitter_warned = True
+                from deepspeed_tpu.utils.logging import logger
+                logger.warning(
+                    "ZeRO-3 gather scheduler: moe.jitter_eps is "
+                    "active, so this trace uses the module path "
+                    "(implicit GSPMD gathers) to keep router-jitter "
+                    "draws identical to the unscheduled engine; set "
+                    "moe.jitter_eps=0.0 to get the scheduled "
+                    "gather/release path for training")
             return False
         if deterministic or self.config.dropout == 0.0:
             return True
@@ -644,22 +870,106 @@ class GPT2ForCausalLM:
         return False
 
     def loss_fn(self, params, batch, rngs=None, deterministic=False,
-                layer_keep_prob=None):
+                layer_keep_prob=None, return_router_stats=False):
         if self._zero3_active(deterministic):
             return self._zero3_loss(params, batch, rngs, deterministic,
-                                    layer_keep_prob)
+                                    layer_keep_prob,
+                                    return_router_stats)
         input_ids, labels = self._shifted_labels(batch)
         kwargs = {}
         if layer_keep_prob is not None:
             kwargs["layer_keep_prob"] = layer_keep_prob
-        hidden, wte = self.module.apply({"params": params}, input_ids,
-                                        deterministic,
-                                        rngs=rngs or {},
-                                        return_hidden=True, **kwargs)
+        out = self.module.apply({"params": params}, input_ids,
+                                deterministic,
+                                rngs=rngs or {},
+                                return_hidden=True, **kwargs)
+        if self.config.moe is not None:
+            (hidden, wte), stats = out
+            return self._moe_loss(hidden, wte, labels, stats,
+                                  return_router_stats)
+        if return_router_stats:
+            raise ValueError(
+                "return_router_stats requires a model built with "
+                "GPT2Config(moe=...)")
+        hidden, wte = out
         return chunked_tied_head_loss(hidden, wte, labels)
 
+    def _moe_loss(self, hidden, wte, labels, stats,
+                  return_router_stats):
+        """CE + weighted aux load-balancing loss; `stats` is the
+        per-MoE-layer mean [E+2] vector (aux at STAT_AUX), so the
+        weight is depth-independent."""
+        from deepspeed_tpu.moe.router import STAT_AUX
+        loss = chunked_tied_head_loss(hidden, wte, labels)
+        loss = loss + jnp.float32(
+            self.config.moe.aux_loss_weight) * stats[STAT_AUX]
+        if return_router_stats:
+            return loss, stats
+        return loss
+
+    def _moe_zero3_specs(self, stacked):
+        """Per-leaf base PartitionSpecs of the stacked MoE cell tree
+        for the ZeRO-3 scheduler: expert leaves keep their expert dim
+        on the `expert` axis through gather/reduce-scatter (the
+        gathered copy stays expert-sharded — gathering over data
+        only); everything else gathers to full. None when the mesh
+        carries no expert axis (nothing to preserve)."""
+        from deepspeed_tpu.runtime.mesh import (EXPERT_AXIS,
+                                                expert_axis_size)
+        mesh = self.config.moe.mesh
+        if mesh is None or expert_axis_size(mesh) <= 1:
+            return None
+        flat, treedef = jax.tree_util.tree_flatten_with_path(stacked)
+        specs = []
+        for path, leaf in flat:
+            name = jax.tree_util.keystr(path)
+            spec = [None] * np.ndim(leaf)
+            # stacked expert leaves: [cells, E, ...] — dim 1 is the
+            # expert dim
+            if "experts" in name and np.ndim(leaf) >= 3:
+                spec[1] = EXPERT_AXIS
+            specs.append(PartitionSpec(*spec))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def _zero3_moe_loss(self, params, batch, rngs, deterministic,
+                        return_router_stats):
+        """The scheduled stage-3 forward of the MoE model: the whole
+        super-cell subtree (dense blocks + MoE block — router,
+        experts and all) is the stacked unit `apply_layers` drives, so
+        expert leaves gather/reduce-scatter per layer window exactly
+        like dense leaves, except their expert dim STAYS on the
+        expert axis (param_specs below). The carry mirrors the module
+        path's (hidden, stats) pair; op sequence identical."""
+        cfg = self.config
+        sched = self._zero3
+        input_ids, labels = self._shifted_labels(batch)
+        wte = sched.gather(params["wte"], name="wte")
+        wpe = sched.gather(params["wpe"], name="wpe")
+        hidden = embed_tokens(cfg, wte, wpe, input_ids)
+
+        stacked = params["h"]
+        cell = _MoECellScan(cfg)
+        base_rng = (rngs or {}).get("dropout", jax.random.PRNGKey(0))
+        lnf_params = sched.gather(params["ln_f"], name="ln_f")
+
+        def body(lp, carry, rng_k):
+            out, _ = cell.apply({"params": lp}, carry, deterministic)
+            return out
+
+        stats0 = jnp.zeros((cfg.moe.num_experts + 2,), jnp.float32)
+        hidden, stats = sched.apply_layers(
+            body, stacked, (hidden, stats0), base_rng, name="h",
+            param_specs=self._moe_zero3_specs(stacked))
+        stats = stats / jnp.float32(cfg.moe_cells)
+        ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon,
+                            dtype=jnp.float32,
+                            param_dtype=cfg.param_dtype)
+        hidden = ln_f.apply({"params": lnf_params}, hidden)
+        return self._moe_loss(hidden.astype(cfg.dtype), wte, labels,
+                              stats, return_router_stats)
+
     def _zero3_loss(self, params, batch, rngs, deterministic,
-                    layer_keep_prob):
+                    layer_keep_prob, return_router_stats=False):
         """The scheduled stage-3 forward: same math as the module path
         (bit-exact at gather_dtype=None), but every parameter use goes
         through the scheduler — embeddings/ln_f gathered once for the
@@ -673,6 +983,14 @@ class GPT2ForCausalLM:
                 "progressive_layer_drop is not supported on the ZeRO-3 "
                 "scheduled path (the engine disables the scheduler "
                 "when PLD is configured)")
+        if self.config.moe is not None:
+            return self._zero3_moe_loss(params, batch, rngs,
+                                        deterministic,
+                                        return_router_stats)
+        if return_router_stats:
+            raise ValueError(
+                "return_router_stats requires a model built with "
+                "GPT2Config(moe=...)")
         cfg = self.config
         sched = self._zero3
         input_ids, labels = self._shifted_labels(batch)
@@ -723,7 +1041,11 @@ class GPT2ForCausalLM:
                                       labels)
 
     def apply(self, params, input_ids, deterministic=True):
-        return self.module.apply({"params": params}, input_ids, deterministic)
+        out = self.module.apply({"params": params}, input_ids,
+                                deterministic)
+        if self.config.moe is not None:
+            out, _stats = out   # logits only; stats ride loss_fn
+        return out
 
     def sparse_grad_paths(self):
         """Param-path substrings whose grads are row-sparse, consumed by
@@ -739,12 +1061,23 @@ class GPT2ForCausalLM:
         """PartitionSpec tree: Megatron-style column/row sharding over the
         `model` mesh axis. Scanned blocks carry a leading layer dim."""
         from flax.traverse_util import flatten_dict, unflatten_dict
+        from deepspeed_tpu.runtime.mesh import expert_axis_size
         flat = flatten_dict(params)
+        moe = self.config.moe
+        expert_sharded = (moe is not None and moe.mesh is not None and
+                          expert_axis_size(moe.mesh) > 1)
         specs = {}
         for path, leaf in flat.items():
             name = "/".join(str(p) for p in path)
             nd = np.ndim(leaf)
             spec = [None] * nd
+            if expert_sharded and "experts" in name and nd >= 3:
+                # stacked expert leaves [cells, E, ...]: the expert
+                # dim shards over the `expert` mesh axis; ZeRO's
+                # data-axis sharding composes on a remaining free dim
+                spec[1] = EXPERT_AXIS
+                specs[path] = PartitionSpec(*spec)
+                continue
             if name == "wte" or name == "wpe":
                 # vocab/position dim sharded over model axis
                 spec[0] = MODEL_AXIS

@@ -1,0 +1,50 @@
+"""Mixture-of-Experts: expert-parallel routing, all-to-all dispatch,
+and grouped-GEMM expert FFNs (ROADMAP item 2 — multiply parameters at
+constant step FLOPs; the turn the upstream lineage shipped as
+DeepSpeed-MoE after the v0.3.11 snapshot this repo reproduces).
+
+The subsystem is GSPMD-declarative like the rest of the repo: routing,
+dispatch and combine are einsums over global arrays with sharding
+constraints placing the expert dimension on the `expert` mesh axis and
+the capacity dimension on the `data` axis — XLA lowers the
+(token-sharded -> expert-sharded) reshard pair to the dispatch/combine
+all-to-alls inside the data-parallel device group (the DeepSpeed-MoE
+communicator layout). Zero host syncs anywhere: router statistics stay
+device-side and drain at the existing monitor fence.
+
+  router.py    gated top-k token routing: softmax gate (fp32), optional
+               logit jitter, capacity-factor dispatch/combine masks,
+               Switch/GShard load-balancing aux loss, device-side
+               router stats ([E+2]: per-expert load, drop frac, aux)
+  dispatch.py  dispatch/combine einsum pair + sharding constraints +
+               the trace-time byte accounting the `moe_dispatch`
+               memory-ledger category samples
+  experts.py   expert FFNs as grouped GEMMs — pairs of experts packed
+               block-diagonally so each GEMM contracts over 2*K (the
+               PR-4 flash-attention packing trick's second user), with
+               the fused bias+GeLU epilogue and optional int8
+               QuantizedDense expert projections
+  layer.py     `MoEMLP` — the flax module models drop in for a dense
+               MLP — plus the unpacked per-expert-loop reference
+               implementation parity tests and the bench leg pin
+               against
+
+See docs/moe.md for the routing math, capacity semantics, and the
+ZeRO-3 / elasticity composition contract.
+"""
+
+from deepspeed_tpu.moe.dispatch import (dispatch_bytes_per_layer,
+                                        reset_dispatch_accounting)
+from deepspeed_tpu.moe.experts import ExpertFFN, grouped_gemm
+from deepspeed_tpu.moe.layer import (MoEConfig, MoEMLP,
+                                     moe_mlp_reference,
+                                     resolve_pack_experts)
+from deepspeed_tpu.moe.router import (router_capacity, top_k_gating,
+                                      STAT_AUX, STAT_DROP)
+
+__all__ = [
+    "MoEConfig", "MoEMLP", "ExpertFFN", "grouped_gemm",
+    "moe_mlp_reference", "resolve_pack_experts", "router_capacity",
+    "top_k_gating", "dispatch_bytes_per_layer",
+    "reset_dispatch_accounting", "STAT_AUX", "STAT_DROP",
+]

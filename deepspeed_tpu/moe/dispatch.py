@@ -1,0 +1,147 @@
+"""All-to-all dispatch/combine + the moe_dispatch byte accounting.
+
+The dispatch pair is expressed GSPMD-declaratively (the repo's ZeRO
+convention — collectives as sharding annotations, not hand-rolled
+loops): tokens enter sharded over the batch axes
+((data, expert) — expert-parallel devices are data-parallel devices),
+the dispatched [E, C, H] tensor is constrained to
+(expert, data, None), and XLA lowers the reshard pair to ONE
+all-to-all before the experts (dispatch) and ONE after (combine),
+inside the data-parallel device group. On meshes without an `expert`
+axis the constraints are skipped and the einsums are plain local
+math — single-device semantics are identical.
+
+Byte accounting: every MoE layer records its UNSHARDED dispatch
+buffer bytes (the [E, C, H] send + recv pair) at trace time into a
+process-global registry — the Zero3GatherScheduler._gather_bytes
+pattern — and the engine samples `dispatch_bytes_per_layer(mesh)`,
+which applies ITS mesh's per-device fraction, as the `moe_dispatch`
+memory-ledger category (a DYNAMIC entry: 0 until the first step
+traces). The recorded number is pure shape arithmetic; tests
+cross-check it against independent byte math from the config (the
+PR-9 window-bound pattern).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from deepspeed_tpu.runtime.mesh import DATA_AXIS, EXPERT_AXIS
+
+# process-global trace-time accounting: {key: (unsharded bytes,
+# num_experts, width) of one MoE layer's dispatch buffers}. Keys are
+# module scope paths; layers are uniform by construction, so
+# consumers read the MAX over entries matching THEIR model's
+# (num_experts, width) signature (the module trace and the ZeRO-3
+# scheduled trace of the same model would otherwise double-count by
+# summing; a second engine's differently-shaped model is filtered
+# out, not maxed in). Recording the unsharded number keeps init-time
+# traces (no mesh bound yet) and engine traces consistent — the
+# CONSUMER applies its own mesh's per-device fraction
+# (`dispatch_bytes_per_layer(mesh, ...)`). Residual limitation: two
+# models of identical (E, H) but different capacity knobs in one
+# process still collapse to the larger (reset_dispatch_accounting
+# between them if that matters).
+_DISPATCH_BYTES = {}
+_LOCK = threading.Lock()
+
+
+def record_dispatch_bytes(key, nbytes, num_experts=None, width=None):
+    with _LOCK:
+        _DISPATCH_BYTES[str(key)] = (int(nbytes), num_experts, width)
+
+
+def dispatch_bytes_per_layer(mesh=None, num_experts=None, width=None):
+    """Per-device dispatch-buffer bytes of ONE MoE layer under `mesh`
+    (0 until a step traces). `num_experts`/`width` filter the
+    recorded entries to THIS model's shape signature (None matches
+    anything). Host dict read + metadata math — fence-safe."""
+    with _LOCK:
+        vals = [b for b, e, h in _DISPATCH_BYTES.values()
+                if (num_experts is None or e is None or
+                    e == num_experts) and
+                (width is None or h is None or h == width)]
+    return int(max(vals, default=0) * per_device_fraction(mesh))
+
+
+def reset_dispatch_accounting():
+    with _LOCK:
+        _DISPATCH_BYTES.clear()
+
+
+def _expert_sharding(mesh, ndim):
+    """(expert, data, None, ...) — the dispatched-tensor placement:
+    expert dim on the expert axis, capacity rows on the data axis."""
+    spec = [None] * ndim
+    spec[0] = EXPERT_AXIS
+    spec[1] = DATA_AXIS
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def _mesh_active(mesh):
+    """Constraints apply only on meshes that CARRY an expert axis —
+    naming `expert` in a PartitionSpec over a 3-axis mesh is a
+    ValueError, and without the axis there is no expert placement to
+    declare (XLA partitions the einsums off the token sharding)."""
+    if mesh is None:
+        return False
+    return EXPERT_AXIS in getattr(mesh, "axis_names", ())
+
+
+def dispatch_tokens(x, dispatch_mask, mesh=None):
+    """[N, H] tokens -> [E, C, H] per-expert buffers (the dispatch
+    all-to-all). `dispatch_mask` [N, E, C] from top_k_gating."""
+    xe = jnp.einsum("nec,nh->ech",
+                    dispatch_mask.astype(x.dtype), x)
+    if _mesh_active(mesh):
+        xe = jax.lax.with_sharding_constraint(
+            xe, _expert_sharding(mesh, xe.ndim))
+    return xe
+
+
+def combine_tokens(ye, combine_weights, mesh=None):
+    """[E, C, H] expert outputs -> [N, H] combined tokens (the combine
+    all-to-all), weighted by the gate probs; dropped tokens get zeros
+    (their residual stream carries them unchanged)."""
+    if _mesh_active(mesh):
+        ye = jax.lax.with_sharding_constraint(
+            ye, _expert_sharding(mesh, ye.ndim))
+    return jnp.einsum("nec,ech->nh",
+                      combine_weights.astype(ye.dtype), ye)
+
+
+def replicate_stats(stats, mesh=None):
+    """Pin the router stats vector to a fully-replicated layout. On an
+    active mesh the SPMD partitioner back-propagates the dispatched
+    tensor's (expert, data) sharding INTO the gating graph and can
+    leave the tiny stats reductions as per-shard partial sums — the
+    fetched vector then reads dp-times too large. An explicit
+    replicated constraint forces the all-reduce (value-identical to
+    the eager trace; pinned by tests/test_moe.py)."""
+    if not _mesh_active(mesh):
+        return stats
+    return jax.lax.with_sharding_constraint(
+        stats, NamedSharding(mesh, PartitionSpec()))
+
+
+def per_device_fraction(mesh):
+    """Fraction of a dispatched [E, C, ...] buffer one device holds:
+    1 / (expert_axis * data_axis) when the mesh shards it, 1
+    otherwise. Pure metadata math for the ledger accounting."""
+    if mesh is None:
+        return 1.0
+    shape = dict(mesh.shape)
+    return 1.0 / (shape.get(EXPERT_AXIS, 1) * shape.get(DATA_AXIS, 1))
+
+
+def dispatch_buffer_nbytes(num_experts, capacity, width, dtype, mesh):
+    """Per-device bytes of one MoE layer's dispatch buffers: the
+    [E, C, H] send tensor + the [E, C, H] expert-output recv tensor
+    (combine reads it back), each holding E*C*H elements divided
+    across the (expert, data) shards."""
+    per_buf = int(num_experts) * int(capacity) * int(width) * \
+        np.dtype(dtype).itemsize
+    return int(2 * per_buf * per_device_fraction(mesh))

@@ -1,0 +1,186 @@
+"""MoEMLP: the drop-in mixture-of-experts MLP, plus its reference.
+
+`MoEMLP` replaces a transformer block's dense MLP (c_fc + GeLU +
+mlp_c_proj) with: a softmax top-k router, capacity-factor all-to-all
+dispatch, grouped-GEMM expert FFNs, and gate-weighted combine. It
+returns `(y, stats)` — the [E+2] router stats vector rides the scan
+carry up to the model loss (aux load-balancing term) and on to the
+monitor fence (the `router` event), never touching the host between
+fences.
+
+`moe_mlp_reference` is the unpacked oracle: the same gating math, but
+a Python per-expert loop of single GEMMs with plain jnp epilogues —
+no block-diagonal packing, no fused launches, no sharding
+constraints. Parity against it (<=1e-5 fp32) is the tentpole's
+correctness contract (tests/test_moe.py + the moe_vs_dense bench
+leg).
+"""
+
+import dataclasses
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.moe.dispatch import (combine_tokens,
+                                        dispatch_buffer_nbytes,
+                                        dispatch_tokens,
+                                        record_dispatch_bytes,
+                                        replicate_stats)
+from deepspeed_tpu.moe.experts import ExpertFFN, expert_ffn_reference
+from deepspeed_tpu.moe.router import router_capacity, top_k_gating
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Model-side MoE configuration (the engine's `moe` config block
+    maps onto this via the model's `configure_moe` hook).
+
+    num_experts / every_n_layers are STRUCTURAL — they shape the
+    parameter tree, so the hook verifies rather than applies them.
+    The router knobs (top_k, capacity_factor, aux_loss_weight,
+    jitter_eps) are trace-time behavior and can change between traces
+    without touching parameters. `mesh` carries the engine mesh so
+    dispatch/combine can place the expert dimension on the `expert`
+    axis (None = no sharding constraints, single-device semantics).
+    `quantized_experts` ("off"|"on"|"auto") runs the expert
+    projections through the PR-13 int8 quantized-compute family;
+    `pack_experts` toggles the block-diagonal grouped-GEMM packing
+    (False = the reference batched einsum; "auto" — the default —
+    packs on real TPU only, the quantized-compute "auto" precedent:
+    the packing trick exists to fill the MXU's 128-wide contraction
+    lanes, while on XLA-CPU the traced block-diagonal assembly is
+    pure overhead)."""
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    every_n_layers: int = 1
+    jitter_eps: float = 0.0
+    quantized_experts: str = "off"
+    quant_block: int = 128
+    pack_experts: Any = "auto"
+    mesh: Any = None
+
+    def validate(self):
+        if self.num_experts < 2:
+            raise ValueError(
+                f"moe.num_experts must be >= 2, got {self.num_experts}")
+        if not 1 <= self.top_k <= self.num_experts:
+            raise ValueError(
+                f"moe.top_k must be in [1, {self.num_experts}], got "
+                f"{self.top_k}")
+        if self.capacity_factor <= 0:
+            raise ValueError(
+                "moe.capacity_factor must be > 0, got "
+                f"{self.capacity_factor}")
+        if self.every_n_layers < 1:
+            raise ValueError(
+                "moe.every_n_layers must be >= 1, got "
+                f"{self.every_n_layers}")
+        if self.aux_loss_weight < 0 or self.jitter_eps < 0:
+            raise ValueError(
+                "moe.aux_loss_weight and moe.jitter_eps must be >= 0")
+        if self.pack_experts not in (True, False, "auto"):
+            raise ValueError(
+                "moe.pack_experts must be True, False or 'auto', got "
+                f"{self.pack_experts!r}")
+        return self
+
+
+def resolve_pack_experts(mode):
+    """`pack_experts` -> bool at trace time: True/False pass through;
+    "auto" packs on real TPU only (the MXU-lane-filling trick; on
+    XLA-CPU the traced block-diagonal assembly costs more than the
+    halved GEMM count saves — measured in the moe_vs_dense leg)."""
+    if mode is True or mode is False:
+        return mode
+    if mode == "auto":
+        return jax.devices()[0].platform == "tpu"
+    raise ValueError(
+        f"pack_experts must be True, False or 'auto', got {mode!r}")
+
+
+class MoEMLP(nn.Module):
+    """Router + dispatch + grouped-GEMM experts + combine.
+
+    Parameters: `wg` [H, E] router weights; `experts` (ExpertFFN)
+    wi/bi/wo/bo with the expert dim leading. Input [B, T, H]; returns
+    (y [B, T, H], stats [E+2]). Dropped tokens produce zeros — the
+    caller's residual connection carries them through unchanged."""
+    moe: MoEConfig
+    d_model: int
+    d_ff: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.normal(0.02)
+    out_kernel_init: Callable = nn.initializers.normal(0.02)
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        moe = self.moe
+        b, t, h = x.shape
+        n = b * t
+        wg = self.param("wg", self.kernel_init,
+                        (h, moe.num_experts), self.param_dtype)
+        xf = x.reshape(n, h)
+        # router in fp32 (tiny GEMM; the gate decision must not move
+        # with the compute dtype)
+        logits = xf.astype(jnp.float32) @ wg.astype(jnp.float32)
+        rng = None
+        if not deterministic and moe.jitter_eps > 0.0 and \
+                self.has_rng("dropout"):
+            rng = self.make_rng("dropout")
+        capacity = router_capacity(n, moe.num_experts, moe.top_k,
+                                   moe.capacity_factor)
+        dispatch, combine, stats = top_k_gating(
+            logits, moe.top_k, capacity, rng=rng,
+            jitter_eps=moe.jitter_eps)
+        # stats must stay replicated: the dispatched tensor's
+        # (expert, data) sharding otherwise back-propagates into the
+        # gating reductions and leaves per-shard PARTIAL sums (a
+        # dp-times-too-large fetched vector; see replicate_stats)
+        stats = replicate_stats(stats, moe.mesh)
+
+        xe = dispatch_tokens(xf.astype(self.dtype), dispatch,
+                             mesh=moe.mesh)
+        ye = ExpertFFN(
+            num_experts=moe.num_experts, d_model=h, d_ff=self.d_ff,
+            dtype=self.dtype, param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+            out_kernel_init=self.out_kernel_init,
+            pack=resolve_pack_experts(moe.pack_experts),
+            quantized=moe.quantized_experts,
+            quant_block=moe.quant_block, name="experts")(xe)
+        # trace-time byte accounting for the `moe_dispatch` ledger
+        # category (host dict write, no device work). UNSHARDED bytes
+        # by design: init-time traces run before a mesh is bound, so
+        # the consumer applies its own mesh's per-device fraction
+        # (dispatch_bytes_per_layer(mesh))
+        record_dispatch_bytes(
+            "/".join(self.path),
+            dispatch_buffer_nbytes(moe.num_experts, capacity, h,
+                                   self.dtype, None),
+            num_experts=moe.num_experts, width=h)
+        y = combine_tokens(ye, combine, mesh=moe.mesh)
+        return y.reshape(b, t, h).astype(self.dtype), stats
+
+
+def moe_mlp_reference(params, x, moe: MoEConfig, dtype=jnp.float32):
+    """Unpacked per-expert-loop reference of MoEMLP.apply: same
+    parameters, same gating, plain einsum dispatch, looped single-GEMM
+    experts. The parity oracle (see module docstring)."""
+    b, t, h = x.shape
+    n = b * t
+    xf = x.reshape(n, h)
+    logits = xf.astype(jnp.float32) @ params["wg"].astype(jnp.float32)
+    capacity = router_capacity(n, moe.num_experts, moe.top_k,
+                               moe.capacity_factor)
+    dispatch, combine, stats = top_k_gating(
+        logits, moe.top_k, capacity)
+    xe = jnp.einsum("nec,nh->ech", dispatch.astype(dtype),
+                    xf.astype(dtype))
+    ye = expert_ffn_reference(params["experts"], xe, dtype=dtype)
+    y = jnp.einsum("nec,ech->nh", combine.astype(dtype), ye)
+    return y.reshape(b, t, h).astype(dtype), stats

@@ -1,0 +1,134 @@
+"""Gated top-k token routing with capacity-factor dispatch masks.
+
+The GShard/Switch formulation over GLOBAL arrays (the repo's
+GSPMD-first convention — no per-shard router divergence to reconcile):
+
+  probs      = softmax(x @ wg) in fp32            [N, E]
+  top-k      = the k highest-prob experts per token, gate values
+               renormalized over the selected k
+  capacity   C = ceil(cf * k * N / E): each expert owns C buffer
+               slots; assignments are ranked choice-major (every
+               token's first choice beats any token's second choice —
+               the GShard priority order), then token-major within a
+               choice. Overflow assignments are DROPPED: the dispatch
+               mask zeroes them, the residual stream carries those
+               tokens unchanged, and the drop count rides the stats
+               vector to the monitor fence.
+  aux loss   E * sum_e f_e * P_e (Switch eq. 4): f_e = fraction of
+               tokens whose FIRST choice is e (non-differentiable
+               count), P_e = mean router prob (the differentiable
+               half) — minimized at the uniform 1/E split.
+
+Everything here is trace-time graph construction on device values:
+reductions, one-hots, cumsums. No data-dependent Python control flow,
+no host syncs (the ds_lint HOTSYNC sweep covers these entrypoints).
+
+Stats vector layout (fp32, [E + 2]):
+  [0:E]  per-expert assignment fraction over ALL k choices,
+         pre-capacity (sums to 1 — the load-balance signal)
+  [E]    dropped fraction of the N*k assignments (STAT_DROP)
+  [E+1]  aux loss value (STAT_AUX)
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+# negative column offsets into the [E + 2] stats vector
+STAT_DROP = -2
+STAT_AUX = -1
+
+
+def router_capacity(tokens, num_experts, top_k, capacity_factor):
+    """Per-expert buffer slots C = ceil(cf * k * tokens / E), floored
+    at 1. Static host math — the capacity is a compiled shape (the
+    dispatch tensors are [E, C, H]), so it derives from the static
+    token count of the traced batch, never a device value."""
+    if tokens <= 0 or num_experts <= 0:
+        raise ValueError(
+            f"router_capacity needs tokens > 0 and num_experts > 0, "
+            f"got tokens={tokens}, num_experts={num_experts}")
+    return max(1, math.ceil(
+        float(capacity_factor) * int(top_k) * int(tokens)
+        / int(num_experts)))
+
+
+def _jitter(logits, rng, eps):
+    """Multiplicative uniform jitter on the router input (Switch's
+    load-balancing exploration trick): logits * U(1-eps, 1+eps)."""
+    noise = jax.random.uniform(
+        rng, logits.shape, logits.dtype, 1.0 - eps, 1.0 + eps)
+    return logits * noise
+
+
+def top_k_gating(logits, top_k, capacity, rng=None, jitter_eps=0.0):
+    """Routing decision for one batch of token logits.
+
+    Args:
+      logits: [N, E] router scores (any float dtype; gating math runs
+        in fp32).
+      top_k: experts per token.
+      capacity: per-expert slots C (see router_capacity).
+      rng / jitter_eps: optional multiplicative logit jitter (training
+        only — pass rng=None for deterministic traces).
+
+    Returns (dispatch, combine, stats):
+      dispatch [N, E, C] f32 0/1 mask — token n occupies slot c of
+        expert e (at most k ones per token, at most C per expert);
+      combine  [N, E, C] f32 — dispatch weighted by the renormalized
+        gate prob of that (token, expert) assignment;
+      stats    [E + 2] f32 — see module docstring. Differentiable
+        through the aux entry only (the mask half is stop-gradiented,
+        matching the Switch estimator).
+    """
+    n, e = logits.shape
+    k = int(top_k)
+    if not 1 <= k <= e:
+        raise ValueError(f"top_k must be in [1, {e}], got {top_k}")
+    logits = logits.astype(jnp.float32)
+    if rng is not None and jitter_eps > 0.0:
+        logits = _jitter(logits, rng, float(jitter_eps))
+    probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)      # [N, k]
+    # renormalize over the selected k (GShard; k=1 leaves probs as-is)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # choice-major capacity assignment: all first choices outrank all
+    # second choices; within a choice, token order breaks ties
+    masks = [jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.float32)
+             for j in range(k)]                        # k x [N, E]
+    taken = jnp.zeros((e,), jnp.float32)               # slots consumed
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    kept = jnp.float32(0.0)
+    for j, mask in enumerate(masks):
+        pos = jnp.cumsum(mask, axis=0) - 1.0 + taken[None, :]  # [N, E]
+        fits = mask * (pos < capacity)
+        slot = jnp.sum(fits * pos, axis=-1).astype(jnp.int32)  # [N]
+        onehot_c = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        d_j = fits[:, :, None] * onehot_c[:, None, :]
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate_vals[:, j, None, None]
+        kept = kept + jnp.sum(fits)
+        taken = taken + jnp.sum(mask, axis=0)
+
+    # the mask half is integer-derived (one-hots of top-k indices) —
+    # no gradient path exists through it; the combine weight is
+    # differentiable through the renormalized gate prob only, the
+    # standard Switch/GShard estimator
+    dispatch = jax.lax.stop_gradient(dispatch)
+
+    # aux loss: f_e from first choices (counts), P_e differentiable
+    f_e = jnp.mean(jax.lax.stop_gradient(masks[0]), axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = jnp.float32(e) * jnp.sum(f_e * p_e)
+
+    load = jnp.sum(jax.lax.stop_gradient(sum(masks)), axis=0) \
+        / jnp.float32(n * k)
+    dropped = 1.0 - kept / jnp.float32(n * k)
+    stats = jnp.concatenate(
+        [load, jnp.stack([jax.lax.stop_gradient(dropped), aux])])
+    return dispatch, combine, stats

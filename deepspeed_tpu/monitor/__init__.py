@@ -108,6 +108,7 @@ class Monitor:
         self._cum = {"steps": 0, "overflow_count": 0, "tokens": 0}
         self._last = {}          # most recent drained window metrics
         self._last_numerics = None
+        self._last_router = None   # last fence's router-event fields
         self._serving_ref = None     # live ServingTracker (serving)
         self._first_nonfinite = None   # sticky first-NaN attribution
         # host-side heartbeat mirror (ages for the flight recorder even
@@ -319,16 +320,18 @@ class Monitor:
     # hot path
     # ------------------------------------------------------------------
     def on_step(self, loss=None, grad_norm=None, loss_scale=None,
-                overflow=None, tokens=0, wire_stats=None, health=None):
+                overflow=None, tokens=0, wire_stats=None, health=None,
+                router=None):
         """Fold one step's metrics. Device scalars stay on device (one
         async jitted add); host numbers go to counters; `health`
-        (numerics stat arrays, monitor/numerics.py) is retained the
-        same way. NO host<->device sync on this path — the
-        fence-alignment guard test pins it."""
+        (numerics stat arrays, monitor/numerics.py) and `router` (the
+        MoE [E+2] router stats vector, deepspeed_tpu/moe/router.py)
+        are retained the same way. NO host<->device sync on this
+        path — the fence-alignment guard test pins it."""
         if not self.enabled:
             return
         self.registry.fold_step(loss, grad_norm, loss_scale, overflow,
-                                tokens, health=health)
+                                tokens, health=health, router=router)
         if wire_stats:
             self.registry.inc("wire/d2h_bytes",
                               wire_stats.get("d2h_bytes", 0))
@@ -473,6 +476,11 @@ class Monitor:
             num_event = base_event("numerics", e._host_steps)
             num_event.update(numerics)
             self._emit(num_event)
+        router = self._summarize_router(window)
+        if router is not None:
+            r_event = base_event("router", e._host_steps)
+            r_event.update(router)
+            self._emit(r_event)
         if self.memory_enabled:
             self._emit_memory_event(e._host_steps)
         self._maybe_flush()
@@ -509,6 +517,28 @@ class Monitor:
             if self._first_nonfinite is not None:
                 ctx["first_nonfinite"] = self._first_nonfinite
             self.flight.set_context(**ctx)
+        return summary
+
+    def _summarize_router(self, window):
+        """The fence's `router` event fields from the drained window's
+        MEAN MoE router-stats vector ([E+2] layout — per-expert load
+        fractions, drop fraction, aux loss; deepspeed_tpu/moe/router).
+        Returns None (and emits nothing) when the window carried no
+        router stats — dense engines never see this event."""
+        router = window.pop("router", None)
+        if router is None:
+            return None
+        vec, steps = router
+        loads = [round(float(v), 6) for v in vec[:-2]]
+        summary = {
+            "num_experts": len(loads),
+            "expert_load": loads,
+            "load_max": round(max(loads), 6) if loads else None,
+            "drop_fraction": round(float(vec[-2]), 6),
+            "aux_loss": round(float(vec[-1]), 6),
+            "window_steps": int(steps),
+        }
+        self._last_router = summary
         return summary
 
     # ------------------------------------------------------------------
@@ -676,7 +706,7 @@ class Monitor:
         "loss_scale", "lr", "overflow_count", "tokens",
         "samples_per_sec", "tokens_per_sec_per_chip", "mfu",
         "memory", "wire", "checkpoint", "prefetch", "numerics",
-        "memory_ledger",
+        "router", "memory_ledger",
     )
 
     def snapshot(self):
@@ -688,6 +718,7 @@ class Monitor:
         window = self.registry.drain_device()
         if window is not None:
             self._summarize_numerics(window)
+            self._summarize_router(window)
             self._last = window
             self._cum["steps"] += window["steps"]
             self._cum["overflow_count"] += window["overflow_count"]
@@ -724,6 +755,7 @@ class Monitor:
                 "depth": gauges.get("prefetch/depth"),
             },
             "numerics": self._last_numerics,
+            "router": self._last_router,
             "memory_ledger": self._reconcile_memory(
                 e._host_steps if e else 0)
             if self.memory_enabled else None,

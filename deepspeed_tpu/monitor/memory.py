@@ -14,6 +14,10 @@ pure index math — NO device sync anywhere in this module):
   zero3_gather    the stage-3 scheduler's live gathered-param window —
                   (prefetch_layers + 1) layers of full params (a
                   DYNAMIC entry; runtime/zero/stage3.py)
+  moe_dispatch    the MoE layers' all-to-all dispatch buffers — the
+                  [E, C, H] send + expert-output recv pair per MoE
+                  layer (a DYNAMIC entry learned at first trace;
+                  deepspeed_tpu/moe/dispatch.py)
   host_master     ZeRO-Offload fp32 masters in host RAM
   host_opt_state  ZeRO-Offload CPU-Adam moments in host RAM
   wire            compressed-wire state: device residual / device flat
@@ -69,6 +73,7 @@ CAT_CKPT = "ckpt_snapshot"
 CAT_PREFETCH = "prefetch"
 CAT_PIPE = "pipe_buffers"
 CAT_KV = "kv_cache"
+CAT_MOE = "moe_dispatch"
 
 # canonical ordering for stacked rendering (Perfetto counter tracks,
 # event dicts): state groups first, transients last (zero3_gather —
@@ -76,10 +81,12 @@ CAT_KV = "kv_cache"
 # with the state groups: it is persistent working memory of the step;
 # kv_cache — the serving engine's preallocated page pool — likewise:
 # the pool is resident for the engine's lifetime, with per-request
-# entries carving it up)
+# entries carving it up; moe_dispatch — the MoE layers' all-to-all
+# send/recv capacity buffers [E, C, H] — is per-step working memory
+# like zero3_gather: a DYNAMIC entry learned at first trace)
 CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS, CAT_ZERO3,
-              CAT_KV, CAT_HOST_MASTER, CAT_HOST_OPT, CAT_WIRE,
-              CAT_CKPT, CAT_PREFETCH, CAT_PIPE)
+              CAT_MOE, CAT_KV, CAT_HOST_MASTER, CAT_HOST_OPT,
+              CAT_WIRE, CAT_CKPT, CAT_PREFETCH, CAT_PIPE)
 
 
 # ----------------------------------------------------------------------
@@ -427,6 +434,16 @@ def oom_hints(payload):
             "bytes scale with prefetch_layers + 1), or set "
             "stage3.release_after_use true if the naive up-front "
             "gather mode is on")
+    if cats.get(CAT_MOE) and ledger and \
+            cats[CAT_MOE] > 0.15 * ledger:
+        hints.append(
+            "MoE dispatch buffers (all-to-all send/recv + capacity "
+            f"slots) hold {cats[CAT_MOE] / 2**30:.2f} GiB of "
+            f"{ledger / 2**30:.2f} GiB ledgered: lower "
+            "moe.capacity_factor (buffer rows scale linearly with it) "
+            "or raise moe.num_experts only together with the mesh "
+            "expert axis (per-device buffer bytes scale with "
+            "num_experts / expert-axis size)")
     if cats.get(CAT_KV) and ledger and \
             cats[CAT_KV] > 0.3 * ledger:
         hints.append(

@@ -51,12 +51,20 @@ class MetricsRegistry:
         # per-fence device_get
         self._pending_health = []   # [(window_step, {"act","grad"})]
         self._health_acc = None
+        # MoE router stats (deepspeed_tpu/moe/router.py): per-step
+        # [E+2] device vectors (per-expert load, drop frac, aux loss)
+        # retained the same way — list append, summed on device at
+        # compaction, drained in the same per-fence device_get; the
+        # fence reports the window MEAN
+        self._pending_router = []
+        self._router_acc = None     # device [E+2] sum over compacted
+        self._router_steps = 0
 
     # ------------------------------------------------------------------
     # device-side accumulator
     # ------------------------------------------------------------------
     def fold_step(self, loss, grad_norm, loss_scale, overflow, tokens,
-                  health=None):
+                  health=None, router=None):
         """Retain one step's device scalars. NO device work, NO sync —
         a list append; the buffers were produced by the step anyway.
         (Never `bool()`/`float()` a device value here: that would be a
@@ -75,6 +83,9 @@ class MetricsRegistry:
         if health is not None and (health.get("act") is not None or
                                    health.get("grad") is not None):
             self._pending_health.append((self._steps, health))
+        if router is not None:
+            self._pending_router.append(router)
+            self._router_steps += 1
         if loss is not None:
             self._loss_steps += 1
         if grad_norm is not None:
@@ -108,6 +119,11 @@ class MetricsRegistry:
             self._health_acc = numerics.fold_entries(
                 [s for s, _ in ph], [h for _, h in ph],
                 self._health_acc)
+        if self._pending_router:
+            pr, self._pending_router = self._pending_router, []
+            part = jnp.sum(jnp.stack(pr).astype(jnp.float32), axis=0)
+            self._router_acc = part if self._router_acc is None \
+                else self._router_acc + part
 
     # ------------------------------------------------------------------
     # host-side counters + gauges
@@ -153,15 +169,19 @@ class MetricsRegistry:
         if self._steps == 0:
             return None
         import jax
-        acc, pend, scale, health_acc, pend_health = jax.device_get(
+        (acc, pend, scale, health_acc, pend_health, router_acc,
+         pend_router) = jax.device_get(
             (self._acc, self._pending, self._scale_last,
-             self._health_acc, self._pending_health))
+             self._health_acc, self._pending_health,
+             self._router_acc, self._pending_router))
         steps, self._steps = self._steps, 0
         loss_steps, self._loss_steps = self._loss_steps, 0
         gnorm_steps, self._gnorm_steps = self._gnorm_steps, 0
+        router_steps, self._router_steps = self._router_steps, 0
         tokens, self._tokens = self._tokens, 0.0
         self._pending, self._acc = [], None
         self._pending_health, self._health_acc = [], None
+        self._pending_router, self._router_acc = [], None
 
         loss_sum = gnorm_sum = ovf_sum = 0.0
         if acc is not None:
@@ -188,4 +208,16 @@ class MetricsRegistry:
             # fetched numpy already (it rode the fused device_get
             # above); the Monitor summarizes with its host-side labels
             out["health"] = (pend_health, health_acc)
+        if router_steps:
+            # window MEAN of the [E+2] router stats vector (per-expert
+            # load fractions, drop fraction, aux loss) — fetched numpy
+            # via the same fused device_get
+            total = np.zeros_like(np.asarray(
+                pend_router[0] if pend_router else router_acc,
+                np.float64))
+            if router_acc is not None:
+                total = total + np.asarray(router_acc, np.float64)
+            for r in pend_router:
+                total = total + np.asarray(r, np.float64)
+            out["router"] = (total / router_steps, int(router_steps))
         return out

@@ -407,6 +407,68 @@ def get_quantized_compute_config(param_dict):
             "stochastic_rounding": sr}
 
 
+def get_moe_config(param_dict):
+    """Validated `moe` block -> dict(enabled, num_experts, top_k,
+    capacity_factor, aux_loss_weight, every_n_layers, jitter_eps).
+    Structural keys (num_experts, every_n_layers) are later VERIFIED
+    against the built model by the engine's configure_moe hook; the
+    router knobs are applied (deepspeed_tpu/moe/)."""
+    block = param_dict.get(C.MOE, {})
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f'"moe" must be a dict, got {block!r}')
+    enabled = bool(get_scalar_param(block, C.MOE_ENABLED,
+                                    C.MOE_ENABLED_DEFAULT))
+    num_experts = get_scalar_param(block, C.MOE_NUM_EXPERTS,
+                                   C.MOE_NUM_EXPERTS_DEFAULT)
+    if not isinstance(num_experts, int) or \
+            isinstance(num_experts, bool) or num_experts < 2:
+        raise DeepSpeedConfigError(
+            f"moe.num_experts must be an int >= 2, got {num_experts!r}")
+    top_k = get_scalar_param(block, C.MOE_TOP_K, C.MOE_TOP_K_DEFAULT)
+    if not isinstance(top_k, int) or isinstance(top_k, bool) or \
+            not 1 <= top_k <= num_experts:
+        raise DeepSpeedConfigError(
+            f"moe.top_k must be an int in [1, num_experts="
+            f"{num_experts}], got {top_k!r}")
+    cf = get_scalar_param(block, C.MOE_CAPACITY_FACTOR,
+                          C.MOE_CAPACITY_FACTOR_DEFAULT)
+    if not isinstance(cf, (int, float)) or isinstance(cf, bool) or \
+            cf <= 0:
+        raise DeepSpeedConfigError(
+            f"moe.capacity_factor must be > 0, got {cf!r}")
+    aux = get_scalar_param(block, C.MOE_AUX_LOSS_WEIGHT,
+                           C.MOE_AUX_LOSS_WEIGHT_DEFAULT)
+    if not isinstance(aux, (int, float)) or isinstance(aux, bool) or \
+            aux < 0:
+        raise DeepSpeedConfigError(
+            f"moe.aux_loss_weight must be >= 0, got {aux!r}")
+    every = get_scalar_param(block, C.MOE_EVERY_N_LAYERS,
+                             C.MOE_EVERY_N_LAYERS_DEFAULT)
+    if not isinstance(every, int) or isinstance(every, bool) or \
+            every < 1:
+        raise DeepSpeedConfigError(
+            f"moe.every_n_layers must be an int >= 1, got {every!r}")
+    jitter = get_scalar_param(block, C.MOE_JITTER_EPS,
+                              C.MOE_JITTER_EPS_DEFAULT)
+    if not isinstance(jitter, (int, float)) or \
+            isinstance(jitter, bool) or jitter < 0:
+        raise DeepSpeedConfigError(
+            f"moe.jitter_eps must be >= 0, got {jitter!r}")
+    known = {C.MOE_ENABLED, C.MOE_NUM_EXPERTS, C.MOE_TOP_K,
+             C.MOE_CAPACITY_FACTOR, C.MOE_AUX_LOSS_WEIGHT,
+             C.MOE_EVERY_N_LAYERS, C.MOE_JITTER_EPS}
+    unknown = set(block) - known
+    if unknown:
+        logger.warning(
+            f"moe: ignoring unknown key(s) {sorted(unknown)}; known "
+            f"keys: {sorted(known)}")
+    return {"enabled": enabled, "num_experts": num_experts,
+            "top_k": top_k, "capacity_factor": float(cf),
+            "aux_loss_weight": float(aux), "every_n_layers": every,
+            "jitter_eps": float(jitter)}
+
+
 def get_autotune_config(param_dict):
     """Validated `autotune` block -> dict(enabled, table_path)."""
     block = param_dict.get(C.AUTOTUNE, {})
@@ -596,6 +658,7 @@ class DeepSpeedConfig:
 
         self.quantized_compute = get_quantized_compute_config(param_dict)
         self.autotune = get_autotune_config(param_dict)
+        self.moe = get_moe_config(param_dict)
 
         self.pld_enabled = get_pld_enabled(param_dict)
         self.pld_params = get_pld_params(param_dict)

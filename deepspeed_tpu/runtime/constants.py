@@ -379,13 +379,66 @@ VOCABULARY_SIZE = "vocabulary_size"
 #############################################
 # TPU-native extensions (no reference analogue)
 #############################################
-# Mesh block: {"mesh": {"data": -1, "model": 1, "pipe": 1}}. -1 = infer.
-# The axis-name constants are the canonical names runtime/mesh.py
-# builds the jax Mesh with.
+# Mesh block: {"mesh": {"data": -1, "model": 1, "pipe": 1, "expert": 1}}.
+# -1 = infer. The axis-name constants are the canonical names
+# runtime/mesh.py builds the jax Mesh with. The `expert` axis exists
+# only when the config names it (3-axis meshes stay byte-identical to
+# the pre-MoE layout): batch data shards over (pipe, data, expert) —
+# expert-parallel devices ARE data-parallel devices, the DeepSpeed-MoE
+# convention — while expert parameters shard their expert dim over it
+# (deepspeed_tpu/moe/).
 MESH = "mesh"
 MESH_DATA_AXIS = "data"
 MESH_MODEL_AXIS = "model"
 MESH_PIPE_AXIS = "pipe"
+MESH_EXPERT_AXIS = "expert"
+
+#############################################
+# Mixture-of-Experts block (TPU-native extension; deepspeed_tpu/moe/):
+# gated top-k token routing + capacity-factor all-to-all dispatch +
+# expert-parallel grouped-GEMM FFNs, wired into supporting models
+# (GPT-2 family) as a config-selectable MoE MLP.
+#   {"moe": {"enabled": true, "num_experts": 8, "top_k": 2,
+#            "capacity_factor": 1.25, "aux_loss_weight": 0.01,
+#            "every_n_layers": 2, "jitter_eps": 0.0}}
+# enabled: validate the block and wire the runtime knobs into the
+#   model's `configure_moe` hook at engine init. The model must be
+#   BUILT with a structurally matching moe config (num_experts /
+#   every_n_layers change the parameter tree, so they are verified,
+#   not applied); router knobs (top_k, capacity_factor,
+#   aux_loss_weight, jitter_eps) are applied — they are trace-time
+#   behavior, not structure.
+# num_experts: experts per MoE layer. Must divide by the mesh `expert`
+#   axis size (each expert-parallel device group owns
+#   num_experts/expert contiguous experts).
+# top_k: experts each token routes to (gate probs renormalized over
+#   the selected k).
+# capacity_factor: per-expert buffer slots = ceil(cf * top_k * tokens
+#   / num_experts); tokens overflowing an expert's capacity are
+#   DROPPED (the residual stream carries them unchanged) and counted
+#   in the per-fence `router` event.
+# aux_loss_weight: weight of the load-balancing auxiliary loss
+#   (Switch/GShard form: E * sum_e f_e * P_e) added to the model loss.
+# every_n_layers: every n-th transformer block uses the MoE MLP
+#   (n_layer must divide evenly); 1 = every block.
+# jitter_eps: multiplicative uniform jitter on router logits during
+#   training (0 = off).
+#############################################
+MOE = "moe"
+MOE_ENABLED = "enabled"
+MOE_ENABLED_DEFAULT = False
+MOE_NUM_EXPERTS = "num_experts"
+MOE_NUM_EXPERTS_DEFAULT = 8
+MOE_TOP_K = "top_k"
+MOE_TOP_K_DEFAULT = 2
+MOE_CAPACITY_FACTOR = "capacity_factor"
+MOE_CAPACITY_FACTOR_DEFAULT = 1.25
+MOE_AUX_LOSS_WEIGHT = "aux_loss_weight"
+MOE_AUX_LOSS_WEIGHT_DEFAULT = 0.01
+MOE_EVERY_N_LAYERS = "every_n_layers"
+MOE_EVERY_N_LAYERS_DEFAULT = 1
+MOE_JITTER_EPS = "jitter_eps"
+MOE_JITTER_EPS_DEFAULT = 0.0
 
 #############################################
 # Async dispatch (TPU-native extension): keep N steps in flight.

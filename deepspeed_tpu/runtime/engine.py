@@ -47,8 +47,10 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from deepspeed_tpu.runtime import constants as C
 from deepspeed_tpu.runtime.config import DeepSpeedConfig
-from deepspeed_tpu.runtime.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
-                                        build_mesh, data_sharding,
+from deepspeed_tpu.runtime.mesh import (DATA_AXIS, EXPERT_AXIS,
+                                        MODEL_AXIS, PIPE_AXIS,
+                                        batch_axes, build_mesh,
+                                        data_sharding, expert_axis_size,
                                         replicated, stacked_batch_pspecs)
 from deepspeed_tpu.runtime.utils import _zeros_like_f32
 from deepspeed_tpu.runtime.zero.partition import ZeroShardingPolicy
@@ -163,8 +165,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         config_dict = load_config_dict(config)
         self.mesh = mesh if mesh is not None else build_mesh(
             config_dict.get(C.MESH))
+        # expert-parallel devices ARE data-parallel devices (the
+        # DeepSpeed-MoE convention): the global batch divides over
+        # every non-model axis, so an `expert` axis multiplies the
+        # data-parallel world exactly like pipe does
         self.dp_world_size = self.mesh.shape[DATA_AXIS] * \
-            self.mesh.shape[PIPE_AXIS]
+            self.mesh.shape[PIPE_AXIS] * expert_axis_size(self.mesh)
         self.mp_world_size = self.mesh.shape[MODEL_AXIS]
 
         self._config = DeepSpeedConfig(config_dict, mpu,
@@ -240,6 +246,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         self._pending_grads = None
         self._pending_loss = None
         self._pending_acts = None
+        self._pending_router = None
         self.losses = None
 
         if self.gradient_predivide_factor() != 1.0 or \
@@ -279,6 +286,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self.steps_per_print()
         self._init_autotune()
         self._init_quantized_compute()
+        self._init_moe()
         self._configure_optimizer()
         self._configure_lr_scheduler(lr_scheduler)
         self._init_state()
@@ -570,12 +578,17 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         """Stage-0 replicated params over a multi-device data-only mesh:
         the scope where per-leaf shard_map collectives (CSR sparse
         grads, 1-bit Adam's compressed allreduce) are legal — the same
-        scope as the reference's non-ZeRO fallback path."""
+        scope as the reference's non-ZeRO fallback path. An `expert`
+        axis disqualifies the mesh: those shard_map programs name only
+        the data axis (in_specs, pmean, worker counts), while batch
+        rows shard over (data, expert) — running them would leave each
+        expert replica redundantly recomputing its whole data slice."""
         return (self.zero_optimization_stage() == 0 and
                 not self._offload_enabled() and
                 self.mesh.shape[DATA_AXIS] > 1 and
                 self.mesh.shape[MODEL_AXIS] == 1 and
-                self.mesh.shape[PIPE_AXIS] == 1)
+                self.mesh.shape[PIPE_AXIS] == 1 and
+                expert_axis_size(self.mesh) == 1)
 
     def _build_optimizer_transform(self):
         self._use_onebit_shardmap = False
@@ -993,6 +1006,61 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 active=bool(applied and
                             resolve_quantized_compute(qc["mode"])))
 
+    def _init_moe(self):
+        """Wire the `moe` config block into the model
+        (deepspeed_tpu/moe/): validate the expert mesh axis against the
+        expert count, call the model's `configure_moe` hook with the
+        engine mesh + router knobs (structural keys are VERIFIED
+        against the built parameter tree, router knobs applied), and
+        emit one `moe` monitor event recording the configuration.
+        Runs BEFORE state init so `tp_param_specs` sees the expert
+        placement when the ZeRO policy is built."""
+        mc = self._config.moe
+        self._moe_active = False
+        self._moe_stats_on = False
+        if not mc["enabled"]:
+            return
+        target = getattr(self, "module", None)
+        hook = getattr(target, "configure_moe", None)
+        if hook is None:
+            logger.warning(
+                "moe.enabled is set but the model "
+                f"({type(target).__name__}) exposes no configure_moe "
+                "hook; the moe block has no effect on this model")
+            return
+        es = expert_axis_size(self.mesh)
+        if mc["num_experts"] % es:
+            raise ValueError(
+                f"moe.num_experts={mc['num_experts']} must divide by "
+                f"the mesh expert axis ({es}): each expert-parallel "
+                "device group owns num_experts/expert contiguous "
+                "experts")
+        hook(mesh=self.mesh,
+             num_experts=mc["num_experts"],
+             every_n_layers=mc["every_n_layers"],
+             top_k=mc["top_k"],
+             capacity_factor=mc["capacity_factor"],
+             aux_loss_weight=mc["aux_loss_weight"],
+             jitter_eps=mc["jitter_eps"])
+        self._moe_active = True
+        # router stats ride the jitted step only when something drains
+        # them (the monitor fence) — dense-engine traces stay identical
+        self._moe_stats_on = self.monitor.enabled
+        if self.monitor.enabled:
+            self.monitor.event(
+                "moe", num_experts=mc["num_experts"],
+                top_k=mc["top_k"],
+                capacity_factor=mc["capacity_factor"],
+                aux_loss_weight=mc["aux_loss_weight"],
+                every_n_layers=mc["every_n_layers"],
+                jitter_eps=mc["jitter_eps"],
+                expert_axis=es)
+        log_dist(
+            f"MoE: {mc['num_experts']} experts (top_k={mc['top_k']}, "
+            f"cf={mc['capacity_factor']}, every_n_layers="
+            f"{mc['every_n_layers']}) over expert axis {es}",
+            ranks=[0])
+
     def _init_zero3_scheduler(self, effective_stage):
         """Build + bind the explicit ZeRO-3 gather/release runtime
         (runtime/zero/stage3.py): layer-granular all-gather prefetched
@@ -1087,6 +1155,25 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             led.register_dynamic(
                 _mem.CAT_ZERO3, "zero3.gather_window",
                 self.zero3_scheduler.live_window_bytes)
+        if getattr(self, "_moe_active", False):
+            # MoE all-to-all dispatch buffers: the [E, C, H] send +
+            # expert-output recv pair per MoE layer — per-layer bytes
+            # learned at trace time (deepspeed_tpu/moe/dispatch.py),
+            # times the model's MoE layer count. DYNAMIC like
+            # zero3_gather: 0 until the first step traces; OOM
+            # forensics can then name moe.capacity_factor as the knob
+            from deepspeed_tpu.moe.dispatch import \
+                dispatch_bytes_per_layer
+            info = getattr(self.module, "moe_info", lambda: None)()
+            n_moe_layers = int((info or {}).get("moe_layers", 1))
+            n_experts = (info or {}).get("num_experts")
+            width = (info or {}).get("width")
+            mesh = self.mesh
+            led.register_dynamic(
+                _mem.CAT_MOE, "moe.dispatch_buffers",
+                lambda: dispatch_bytes_per_layer(
+                    mesh, num_experts=n_experts,
+                    width=width) * n_moe_layers)
 
     def _count_model_params(self, tree):
         """Model parameter count for logs/profiling; engines whose
@@ -1098,9 +1185,12 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     # jitted step functions
     # ------------------------------------------------------------------
     def _scaled_loss_fn(self, params, batch, rng, loss_scale, keep_prob):
-        """Returns (scaled_loss, (raw_loss, act_stats)); act_stats is
-        None unless numerics health is on AND the model resolution
-        provided a boundary-tapping loss (`_loss_and_health_fn`)."""
+        """Returns (scaled_loss, (raw_loss, act_stats, router_stats)).
+        act_stats is None unless numerics health is on AND the model
+        resolution provided a boundary-tapping loss
+        (`_loss_and_health_fn`); router_stats ([E+2] device vector —
+        per-expert load, drop fraction, aux loss) is None unless an
+        MoE model is wired AND the monitor drains it at fences."""
         gas = self._jit_gas()
         # "quant" is the per-step stream the quantized-compute family's
         # stochastic rounding consumes (decorrelated from dropout by the
@@ -1110,25 +1200,35 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         kwargs = {}
         if self.progressive_layer_drop is not None:
             kwargs["layer_keep_prob"] = keep_prob
+        rstats = None
         if self._numerics_on and self._loss_and_health_fn is not None:
             loss, acts = self._loss_and_health_fn(
                 params, batch, rngs=rngs, deterministic=False, **kwargs)
+        elif self._moe_stats_on:
+            # the stats already live in the traced loss graph (the aux
+            # term consumes them) — returning them adds no compute,
+            # and they stay device-side until the monitor fence
+            loss, rstats = self._loss_fn(
+                params, batch, rngs=rngs, deterministic=False,
+                return_router_stats=True, **kwargs)
+            acts = None
         else:
             loss = self._loss_fn(params, batch, rngs=rngs,
                                  deterministic=False, **kwargs)
             acts = None
-        return loss * (loss_scale / gas), (loss, acts)
+        return loss * (loss_scale / gas), (loss, acts, rstats)
 
     def _micro_grad(self, params, batch, rng, loss_scale, keep_prob):
-        """(raw_loss, grads, act_stats) for one microbatch; act_stats
-        is None unless numerics activation tapping is active."""
+        """(raw_loss, grads, act_stats, router_stats) for one
+        microbatch; act_stats is None unless numerics activation
+        tapping is active, router_stats unless MoE stats are on."""
         if self._use_shardmap_grads:
             loss, grads = self._micro_grad_shardmap(params, batch, rng,
                                                     loss_scale, keep_prob)
-            return loss, grads, None
+            return loss, grads, None, None
         grad_fn = jax.value_and_grad(self._scaled_loss_fn, has_aux=True)
-        (_, (raw_loss, acts)), grads = grad_fn(params, batch, rng,
-                                               loss_scale, keep_prob)
+        (_, (raw_loss, acts, rstats)), grads = grad_fn(
+            params, batch, rng, loss_scale, keep_prob)
         if not (self.bf16_sr_mode and self._jit_gas() == 1):
             # fp32 grads for accumulation / the fp32-master update. In
             # SR mode at gas=1 they stay in compute dtype: the update
@@ -1142,7 +1242,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         grads = self.zero_policy.encode(grads, self._zero_pad_plan)
         grads = jax.lax.with_sharding_constraint(
             grads, self._acc_shardings)
-        return raw_loss, grads, acts
+        return raw_loss, grads, acts, rstats
 
     def _sparse_grad_paths(self):
         if not self.sparse_gradients_enabled():
@@ -1173,11 +1273,11 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 rng, jax.lax.axis_index(DATA_AXIS))
             grad_fn = jax.value_and_grad(self._scaled_loss_fn,
                                          has_aux=True)
-            # act stats are dropped on the CSR shard_map path (its
-            # out_specs predate numerics health; stage-0 sparse models
-            # still get grad-group stats from the update tail)
-            (_, (raw_loss, _acts)), grads = grad_fn(params, batch, rng,
-                                                    loss_scale, kp)
+            # act/router stats are dropped on the CSR shard_map path
+            # (its out_specs predate numerics health; stage-0 sparse
+            # models still get grad-group stats from the update tail)
+            (_, (raw_loss, _acts, _rstats)), grads = grad_fn(
+                params, batch, rng, loss_scale, kp)
             tokens = int(np.prod(
                 jax.tree_util.tree_leaves(batch)[0].shape))
 
@@ -1384,32 +1484,39 @@ class DeepSpeedEngine(ZeroOffloadMixin):
     def _scan_microbatches(self, micro_fn, acc0, stacked_batch, rng, gas,
                            force_scan=False):
         """Accumulate over the gas microbatches of a stacked [gas, ...]
-        batch. micro_fn(mb, rng) -> (loss, grads, act_stats). Returns
-        (grads_or_acc, mean_loss, act_stats) — act_stats ([L,3] device
-        numerics health, or None) reduced over microbatches
-        (max/mean/sum per column). gas==1 skips the accumulator and the
-        per-microbatch rng fold (grads flow straight to the update)
-        unless force_scan — the offload path always accumulates into
-        its persistent buffer."""
+        batch. micro_fn(mb, rng) -> (loss, grads, act_stats,
+        router_stats). Returns (grads_or_acc, mean_loss, act_stats,
+        router_stats) — act_stats ([L,3] device numerics health, or
+        None) reduced over microbatches (max/mean/sum per column),
+        router_stats ([E+2], or None) averaged over microbatches.
+        gas==1 skips the accumulator and the per-microbatch rng fold
+        (grads flow straight to the update) unless force_scan — the
+        offload path always accumulates into its persistent buffer."""
         if gas == 1 and not force_scan:
             mb = jax.tree_util.tree_map(lambda x: x[0], stacked_batch)
-            loss, grads, acts = micro_fn(mb, rng)
-            return grads, loss, acts
+            loss, grads, acts, rstats = micro_fn(mb, rng)
+            return grads, loss, acts, rstats
 
         def body(carry, mb):
             acc, i = carry
-            loss, grads, acts = micro_fn(mb, jax.random.fold_in(rng, i))
+            loss, grads, acts, rstats = micro_fn(
+                mb, jax.random.fold_in(rng, i))
             acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-            # acts=None is an empty pytree: scan stacks nothing
-            return (acc, i + 1), (loss, acts)
+            # acts/rstats=None are empty pytrees: scan stacks nothing
+            return (acc, i + 1), (loss, acts, rstats)
 
-        (acc, _), (losses, acts) = jax.lax.scan(
+        (acc, _), (losses, acts, rstats) = jax.lax.scan(
             body, (acc0, jnp.asarray(0, jnp.int32)), stacked_batch,
             length=gas)
         if acts is not None:
             from deepspeed_tpu.monitor import numerics as _num
             acts = _num.combine_act_microbatches(acts)
-        return acc, jnp.mean(losses), acts
+        if rstats is not None:
+            # [gas, E+2] -> [E+2]: every entry (load/drop fractions,
+            # aux) is a per-step mean quantity — average over the
+            # accumulation window
+            rstats = jnp.mean(rstats, axis=0)
+        return acc, jnp.mean(losses), acts, rstats
 
     def _build_step_fns(self):
         mesh = self.mesh
@@ -1455,10 +1562,10 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             def fused_grads_only(state, stacked_batch, rng, keep_prob):
                 micro = lambda mb, r: self._micro_grad(
                     state.params, mb, r, state.scale.loss_scale, keep_prob)
-                acc, loss, acts = self._scan_microbatches(
+                acc, loss, acts, rstats = self._scan_microbatches(
                     micro, state.acc_grads, stacked_batch, rng, gas,
                     force_scan=True)
-                return state._replace(acc_grads=acc), loss, acts
+                return state._replace(acc_grads=acc), loss, acts, rstats
 
             self._offload_grads_jit = jax.jit(fused_grads_only,
                                               donate_argnums=(0,))
@@ -1468,7 +1575,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             lr = self._resolve_step_lr(state, lr)
             micro = lambda mb, r: self._micro_grad(
                 state.params, mb, r, state.scale.loss_scale, keep_prob)
-            out, loss, acts = self._scan_microbatches(
+            out, loss, acts, rstats = self._scan_microbatches(
                 micro, state.acc_grads, stacked_batch, rng, gas)
             if gas == 1:
                 # no accumulator: grads flow straight into the update
@@ -1480,7 +1587,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     self._unscale_clip_and_update(state, lr)
             health = {"grad": hgrad, "act": acts} \
                 if self._numerics_on else None
-            return new_state, loss, overflow, grad_norm, health
+            return new_state, loss, overflow, grad_norm, health, rstats
 
         self._fused_step_jit = jax.jit(fused_train_step,
                                        donate_argnums=(0,))
@@ -1525,16 +1632,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     mb_rng, jax.lax.axis_index(DATA_AXIS))
                 grad_fn = jax.value_and_grad(self._scaled_loss_fn,
                                              has_aux=True)
-                (_, (raw_loss, _acts)), grads = grad_fn(
+                (_, (raw_loss, _acts, _rstats)), grads = grad_fn(
                     state.params, mb, mb_rng, state.scale.loss_scale,
                     keep_prob)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(jnp.float32), grads)
-                # numerics health is dropped on the compressed 1-bit
-                # path (its shard_map out_specs predate it)
-                return jax.lax.pmean(raw_loss, DATA_AXIS), grads, None
+                # numerics health + router stats are dropped on the
+                # compressed 1-bit path (its shard_map out_specs
+                # predate them)
+                return (jax.lax.pmean(raw_loss, DATA_AXIS), grads,
+                        None, None)
 
-            grads, loss, _acts = self._scan_microbatches(
+            grads, loss, _acts, _rstats = self._scan_microbatches(
                 micro, _zeros_like_f32(state.params), stacked_batch,
                 rng, gas)
             # with_health=False: nothing consumes health here — don't
@@ -1568,9 +1677,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 out_specs=(st_specs, P(), P(), P()),
                 check_vma=False)(state, stacked_batch, rng, lr,
                                  keep_prob)
-            # arity parity with _fused_step_jit (no numerics health on
-            # the compressed path)
-            return new_state, loss, overflow, grad_norm, None
+            # arity parity with _fused_step_jit (no numerics health or
+            # router stats on the compressed path)
+            return new_state, loss, overflow, grad_norm, None, None
 
         self._onebit_compressed_jit = jax.jit(compressed_step,
                                               donate_argnums=(0,))
@@ -1660,15 +1769,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             if jax.tree_util.tree_leaves(batch) else ()
         self._tokens_per_sample = int(np.prod(lead[1:])) \
             if len(lead) > 1 else 1
-        loss, grads, acts = self._micro_grad_jit(
+        loss, grads, acts, rstats = self._micro_grad_jit(
             self.state.params, batch, self._next_rng(),
             self.state.scale.loss_scale, self._keep_prob())
         self._pending_grads = grads
         self._pending_loss = loss
-        # numerics health, manual path: the LAST microbatch's boundary
-        # stats stand in for the accumulation window (device array, no
-        # sync; folded at the model step)
+        # numerics health / router stats, manual path: the LAST
+        # microbatch's stats stand in for the accumulation window
+        # (device arrays, no sync; folded at the model step)
         self._pending_acts = acts
+        self._pending_router = rstats
         if self._spans_active():
             self.monitor.trace.stop(SPAN_FORWARD)
         return loss
@@ -1736,11 +1846,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                               "act": getattr(self, "_pending_acts",
                                              None)}
                     self._pending_acts = None
+                router = self._pending_router
+                self._pending_router = None
                 self.monitor.on_step(
                     loss=self.losses, grad_norm=self._offload_last_norm,
                     loss_scale=self._host_scaler.cur_scale,
                     overflow=overflow, tokens=tokens,
-                    wire_stats=self.wire_stats, health=health)
+                    wire_stats=self.wire_stats, health=health,
+                    router=router)
             self._after_model_step(jnp.asarray(overflow))
             return
         if self._use_onebit_shardmap and not self._onebit_warned_manual \
@@ -1762,10 +1875,13 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 health = {"grad": hgrad,
                           "act": getattr(self, "_pending_acts", None)}
                 self._pending_acts = None
+            router = self._pending_router
+            self._pending_router = None
             self.monitor.on_step(
                 loss=self.losses, grad_norm=grad_norm,
                 loss_scale=self.state.scale.loss_scale,
-                overflow=overflow, tokens=tokens, health=health)
+                overflow=overflow, tokens=tokens, health=health,
+                router=router)
         self._after_model_step(overflow)
 
     def _next_lr(self):
@@ -1878,12 +1994,18 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         the sharding already matches. Input pipelines call this ahead
         of time to prefetch; train_batch applies it to whatever it is
         handed."""
+        # expert-parallel devices are data-parallel devices: batch rows
+        # divide over (data, expert) when the mesh carries an expert
+        # axis (deepspeed_tpu/moe/), over data alone otherwise
+        row_axes = (DATA_AXIS, EXPERT_AXIS) \
+            if expert_axis_size(self.mesh) > 1 else DATA_AXIS
+
         def put_stacked(x):
             if not isinstance(x, jax.Array):
                 x = np.asarray(x)
             spec = [None] * np.ndim(x)
             if np.ndim(x) > 1:
-                spec[1] = DATA_AXIS
+                spec[1] = row_axes
             return jax.device_put(
                 x, NamedSharding(self.mesh, PartitionSpec(*spec)))
 
@@ -1974,8 +2096,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
         if self._spans_active():
             self.monitor.trace.start(SPAN_STEP)
         health = None
+        rstats = None
         if self._offload_enabled():
-            self.state, loss, acts = self._offload_grads_jit(
+            self.state, loss, acts, rstats = self._offload_grads_jit(
                 self.state, batch, self._next_rng(), self._keep_prob())
             overflow = jnp.asarray(self._offload_take_step(lr))
             grad_norm = None
@@ -2004,8 +2127,9 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                         ranks=[0])
                 if self._onebit_compressed_active:
                     step_fn = self._onebit_compressed_jit
-            self.state, loss, overflow, grad_norm, health = step_fn(
-                self.state, batch, self._next_rng(), lr, self._keep_prob())
+            self.state, loss, overflow, grad_norm, health, rstats = \
+                step_fn(self.state, batch, self._next_rng(), lr,
+                        self._keep_prob())
         if self._spans_active():
             self.monitor.trace.stop(SPAN_STEP)
         mbs = self._microbatches_per_step()
@@ -2019,12 +2143,14 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                     loss=loss, grad_norm=self._offload_last_norm,
                     loss_scale=self._host_scaler.cur_scale,
                     overflow=overflow, tokens=tokens,
-                    wire_stats=self.wire_stats, health=health)
+                    wire_stats=self.wire_stats, health=health,
+                    router=rstats)
             else:
                 self.monitor.on_step(
                     loss=loss, grad_norm=grad_norm,
                     loss_scale=self.state.scale.loss_scale,
-                    overflow=overflow, tokens=tokens, health=health)
+                    overflow=overflow, tokens=tokens, health=health,
+                    router=rstats)
         self._after_model_step(overflow)
         # one fused step consumed `mbs` microbatches worth of samples
         self.tput_timer.stop(count=mbs)

@@ -7,6 +7,15 @@ every collective the framework issues — XLA lowers them onto ICI/DCN.
 
 Config: {"mesh": {"pipe": 1, "data": -1, "model": 1}}; -1 infers the axis
 size from the device count. Defaults to pure data parallelism.
+
+Expert parallelism (deepspeed_tpu/moe/) adds an OPT-IN fourth axis:
+{"mesh": {"expert": E}} builds ('pipe', 'data', 'expert', 'model') —
+the axis exists only when the config names it, so every 3-axis caller
+sees exactly the historical mesh. Batch data shards over
+(pipe, data, expert): expert-parallel devices ARE data-parallel
+devices (the DeepSpeed-MoE convention — the dispatch all-to-all runs
+inside the data-parallel group), while expert parameters shard their
+expert dimension over the axis.
 """
 
 import math
@@ -17,6 +26,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from deepspeed_tpu.runtime.constants import (MESH_DATA_AXIS,
+                                             MESH_EXPERT_AXIS,
                                              MESH_MODEL_AXIS,
                                              MESH_PIPE_AXIS)
 
@@ -24,37 +34,51 @@ from deepspeed_tpu.runtime.constants import (MESH_DATA_AXIS,
 PIPE_AXIS = MESH_PIPE_AXIS
 DATA_AXIS = MESH_DATA_AXIS
 MODEL_AXIS = MESH_MODEL_AXIS
+EXPERT_AXIS = MESH_EXPERT_AXIS
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, MODEL_AXIS)
+# axis order WITH expert parallelism: expert sits between data and
+# model — dispatch all-to-alls are batch-volume collectives (wider
+# than tensor-parallel psums, narrower than data-parallel grad
+# reductions), so they get the middling ICI locality
+AXIS_ORDER_EXPERT = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, MODEL_AXIS)
+
+
+def expert_axis_size(mesh: Mesh) -> int:
+    """Size of the expert axis (1 on meshes built without one)."""
+    return dict(mesh.shape).get(EXPERT_AXIS, 1)
 
 
 def build_mesh(mesh_config: Optional[dict] = None, devices=None) -> Mesh:
-    """Build a 3-axis mesh.  Axis order (pipe, data, model) keeps the
-    model axis innermost/fastest-varying — tensor-parallel collectives are
-    the most latency-sensitive, so they get the shortest ICI hops."""
+    """Build a 3-axis mesh (4-axis when the config names `expert`).
+    Axis order (pipe, data, [expert,] model) keeps the model axis
+    innermost/fastest-varying — tensor-parallel collectives are the
+    most latency-sensitive, so they get the shortest ICI hops."""
     if devices is None:
         devices = jax.devices()
     n = len(devices)
     cfg = dict(mesh_config or {})
-    pipe = int(cfg.get(PIPE_AXIS, 1))
-    data = int(cfg.get(DATA_AXIS, -1))
-    model = int(cfg.get(MODEL_AXIS, 1))
+    axes = AXIS_ORDER_EXPERT if EXPERT_AXIS in cfg else AXIS_ORDER
+    sizes = {PIPE_AXIS: int(cfg.get(PIPE_AXIS, 1)),
+             DATA_AXIS: int(cfg.get(DATA_AXIS, -1)),
+             MODEL_AXIS: int(cfg.get(MODEL_AXIS, 1))}
+    if EXPERT_AXIS in cfg:
+        sizes[EXPERT_AXIS] = int(cfg.get(EXPERT_AXIS))
 
-    known = [s for s in (pipe, data, model) if s != -1]
+    known = [sizes[a] for a in axes if sizes[a] != -1]
     n_known = math.prod(known) if known else 1
-    n_unknown = sum(1 for s in (pipe, data, model) if s == -1)
-    assert n_unknown <= 1, "at most one mesh axis may be -1 (inferred)"
-    if n_unknown == 1:
+    unknown = [a for a in axes if sizes[a] == -1]
+    assert len(unknown) <= 1, \
+        "at most one mesh axis may be -1 (inferred)"
+    if unknown:
         assert n % n_known == 0, \
             f"device count {n} not divisible by fixed axis product {n_known}"
-        inferred = n // n_known
-        pipe = inferred if pipe == -1 else pipe
-        data = inferred if data == -1 else data
-        model = inferred if model == -1 else model
-    assert pipe * data * model == n, \
-        f"mesh {pipe}x{data}x{model} != device count {n}"
+        sizes[unknown[0]] = n // n_known
+    dims = tuple(sizes[a] for a in axes)
+    assert math.prod(dims) == n, \
+        f"mesh {'x'.join(map(str, dims))} != device count {n}"
 
-    dev_array = np.asarray(devices).reshape(pipe, data, model)
-    return Mesh(dev_array, AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, axes)
 
 
 def host_device_groups(devices=None, num_hosts=1):
@@ -86,8 +110,11 @@ def reform_mesh(devices, mesh_config: Optional[dict] = None) -> Mesh:
     """Re-form a mesh over an EXPLICIT surviving device list (elastic
     recovery after host loss): same axis semantics as build_mesh, with
     the data axis inferred from whatever devices remain unless the
-    config pins it. Raises on an empty survivor set rather than
-    building a zero-device mesh."""
+    config pins it. A pinned `expert` axis survives the re-form — the
+    data axis absorbs the loss, so expert state re-plans onto the same
+    expert-group count (the survivor count must stay divisible by the
+    pinned axes; build_mesh raises otherwise). Raises on an empty
+    survivor set rather than building a zero-device mesh."""
     devices = list(devices)
     if not devices:
         raise ValueError("cannot re-form a mesh over zero devices")
@@ -100,11 +127,24 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def batch_axes(mesh: Mesh):
+    """The mesh axes the batch dimension shards over: ('pipe',) 'data'
+    (, 'expert') — every non-model axis present on this mesh. One
+    name, a tuple otherwise (PartitionSpec treats them the same)."""
+    shape = dict(mesh.shape)
+    axes = [a for a in (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS)
+            if shape.get(a, 1) > 1 or a == DATA_AXIS]
+    # drop size-1 pipe/expert for spec-literal parity with the
+    # historical 3-axis behavior ((pipe, data) only when pipe > 1)
+    return tuple(axes) if len(axes) > 1 else axes[0]
+
+
 def data_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
-    """Batch-dim sharding for input arrays: shard dim 0 over ('pipe','data')
-    so the global batch divides across all non-model devices."""
+    """Batch-dim sharding for input arrays: shard dim 0 over every
+    non-model axis (('pipe','data','expert') as present) so the global
+    batch divides across all non-model devices."""
     spec = [None] * ndim
-    spec[0] = (PIPE_AXIS, DATA_AXIS) if mesh.shape[PIPE_AXIS] > 1 else DATA_AXIS
+    spec[0] = batch_axes(mesh)
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
@@ -113,15 +153,21 @@ def batch_sharding_for_tree(mesh: Mesh, tree):
         lambda x: data_sharding(mesh, np.ndim(x)), tree)
 
 
-def stacked_batch_pspecs(tree):
+def stacked_batch_pspecs(tree, mesh: Optional[Mesh] = None):
     """PartitionSpecs for a microbatch-stacked batch pytree
     [gas, batch, ...]: shard dim 1 (the per-microbatch batch dim) over
-    the data axis; scalars/1-D leaves stay replicated. Shared by every
-    shard_map entry point that consumes the fused step's stacked batch
-    (sparse-grad path, 1-bit Adam compressed path, pipeline executor)."""
+    the data axis (plus the expert axis when `mesh` carries one);
+    scalars/1-D leaves stay replicated. Shared by every shard_map
+    entry point that consumes the fused step's stacked batch
+    (sparse-grad path, 1-bit Adam compressed path, pipeline
+    executor)."""
+    row_axes = DATA_AXIS
+    if mesh is not None and expert_axis_size(mesh) > 1:
+        row_axes = (DATA_AXIS, EXPERT_AXIS)
+
     def one(x):
         spec = [None] * np.ndim(x)
         if np.ndim(x) > 1:
-            spec[1] = DATA_AXIS
+            spec[1] = row_axes
         return PartitionSpec(*spec)
     return jax.tree_util.tree_map(one, tree)

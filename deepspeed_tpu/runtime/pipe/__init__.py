@@ -2,4 +2,4 @@ from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
                                                TiedLayerSpec)
 from deepspeed_tpu.runtime.pipe.topology import (
     ProcessTopology, PipeDataParallelTopology, PipeModelDataParallelTopology,
-    PipelineParallelGrid)
+    PipelineParallelGrid, topology_from_mesh)

@@ -387,7 +387,9 @@ class PipelineEngine(DeepSpeedEngine):
                 self._unscale_clip_and_update(state, lr, grads=grads)
             health = {"grad": hgrad, "act": None} \
                 if self._numerics_on else None
-            return new_state, loss, overflow, grad_norm, health
+            # arity parity with the base _fused_step_jit (no MoE
+            # router stats on the 1F1B pipeline path)
+            return new_state, loss, overflow, grad_norm, health, None
 
         # the base train_batch dispatches whatever _fused_step_jit is;
         # the 1F1B program replaces the sequential-chain scan

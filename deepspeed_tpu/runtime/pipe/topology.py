@@ -138,6 +138,20 @@ class PipeModelDataParallelTopology(ProcessTopology):
                          dims=[num_pp, num_dp, num_mp])
 
 
+def topology_from_mesh(mesh):
+    """ProcessTopology over ALL of a jax Mesh's named axes, in the
+    mesh's own (major -> minor) order. Extensible by construction: a
+    4-axis mesh with an `expert` axis (deepspeed_tpu/moe/) produces an
+    expert coordinate in every rank repr and comm-group computation —
+    hardcoding the historical ["pipe", "data", "model"] set here would
+    silently drop the axis from rank math (and break
+    `_is_grid_valid`, since the axis product must equal the device
+    count)."""
+    shape = dict(mesh.shape)
+    return ProcessTopology(axes=list(shape.keys()),
+                           dims=list(shape.values()))
+
+
 class PipelineParallelGrid:
     """Megatron-compatible `mpu` facade over a topology / jax Mesh
     (ref `topology.py:252-455`).
@@ -153,10 +167,9 @@ class PipelineParallelGrid:
                  global_rank=0):
         if topology is None:
             assert mesh is not None, "need a topology or a mesh"
-            shape = dict(mesh.shape)
-            topology = PipeModelDataParallelTopology(
-                num_pp=shape.get("pipe", 1), num_mp=shape.get("model", 1),
-                num_dp=shape.get("data", 1))
+            # ALL mesh axes, not a hardcoded 3-axis set: a mesh with
+            # an `expert` axis keeps it in rank reprs and group math
+            topology = topology_from_mesh(mesh)
         self._topo = topology
         self.mesh = mesh
         self.global_rank = global_rank
@@ -165,6 +178,7 @@ class PipelineParallelGrid:
         self.data_parallel_size = max(topology.get_dim("data"), 1)
         self.pipe_parallel_size = max(topology.get_dim("pipe"), 1)
         self.model_parallel_size = max(topology.get_dim("model"), 1)
+        self.expert_parallel_size = max(topology.get_dim("expert"), 1)
         self.slice_parallel_size = self.model_parallel_size
         assert self._is_grid_valid(), "Invalid Grid"
 
@@ -224,6 +238,16 @@ class PipelineParallelGrid:
 
     def get_data_parallel_world_size(self):
         return self.data_parallel_size
+
+    # -- expert parallel (deepspeed_tpu/moe/) ---------------------------
+    def get_expert_parallel_rank(self):
+        if "expert" not in self._topo.get_axis_names():
+            return 0
+        return getattr(self._topo.get_coord(rank=self.global_rank),
+                       "expert")
+
+    def get_expert_parallel_world_size(self):
+        return self.expert_parallel_size
 
     # -- model (tensor) parallel ----------------------------------------
     def get_model_parallel_rank(self):

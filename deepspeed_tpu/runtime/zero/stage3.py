@@ -46,6 +46,16 @@ cannot hoist every gather to the top of the program.
 stays live through forward AND backward, and its gradient materializes
 as a full stacked tree before one bulk reduce-scatter.
 
+Expert parallelism (deepspeed_tpu/moe/) composes through `param_specs`:
+a per-leaf pytree of BASE PartitionSpecs naming axes a leaf keeps
+through the schedule. An expert leaf's gathered copy is constrained to
+its base spec instead of full replication — the all-gather runs over
+the data axis ONLY, the expert dim stays sharded on the `expert` mesh
+axis — and its backward reduce-scatters into the data shard composed
+ON TOP of the base spec (`leaf_data_spec(existing_spec=base)`).
+Non-expert leaves pass `None` specs and get the historical
+full-replication behavior, so the dense path is byte-identical.
+
 Everything here is trace-time graph construction — no host<->device
 synchronization is ever added to the step (guard-tested).
 """
@@ -172,18 +182,40 @@ class Zero3GatherScheduler:
         self.stack_info = {}
 
     # -- specs / byte arithmetic (static metadata only) ------------------
-    def _full_sharding(self, ndim):
-        return NamedSharding(self.mesh, PartitionSpec(*([None] * ndim)))
+    def _full_sharding(self, ndim, base_spec=None):
+        """Sharding of a GATHERED leaf: data-replicated, but keeping
+        every axis of `base_spec` (e.g. the expert dim of an expert
+        leaf stays on the `expert` axis — the gather never replicates
+        over it)."""
+        if base_spec is None:
+            return NamedSharding(self.mesh,
+                                 PartitionSpec(*([None] * ndim)))
+        return NamedSharding(self.mesh, base_spec)
 
-    def _shard_sharding(self, shape):
+    def _shard_sharding(self, shape, base_spec=None):
         return NamedSharding(
             self.mesh,
             leaf_data_spec(jax.ShapeDtypeStruct(tuple(shape), jnp.float32),
-                           self.dp_size))
+                           self.dp_size, existing_spec=base_spec))
 
-    def _gathered_nbytes(self, shape, dtype):
+    def _base_fraction(self, base_spec):
+        """Fraction of a leaf ONE device holds under its base spec
+        (1 when None — fully replicated after the gather)."""
+        if base_spec is None:
+            return 1.0
+        frac = 1.0
+        shape = dict(self.mesh.shape)
+        for axis in base_spec:
+            if axis is None:
+                continue
+            for a in (axis if isinstance(axis, tuple) else (axis,)):
+                frac /= shape.get(a, 1)
+        return frac
+
+    def _gathered_nbytes(self, shape, dtype, base_spec=None):
         dt = self.gather_dtype or dtype
-        return int(np.prod(shape)) * np.dtype(dt).itemsize
+        return int(np.prod(shape) * np.dtype(dt).itemsize *
+                   self._base_fraction(base_spec))
 
     def live_window_bytes(self):
         """Total live gathered-param bytes per device under the current
@@ -192,28 +224,33 @@ class Zero3GatherScheduler:
         return int(sum(self._gather_bytes.values()))
 
     # -- standalone gather ----------------------------------------------
-    def gather(self, tree, name=None, depend=None):
+    def gather(self, tree, name=None, depend=None, param_specs=None):
         """Differentiable all-gather of a sharded param tree to full
         (data-replicated) values; the backward reduce-scatters each
         cotangent into the owning shard. `depend` (an activation)
         fences the gather so it cannot be hoisted ahead of that value's
-        computation — the unrolled-chain form of prefetch ordering."""
+        computation — the unrolled-chain form of prefetch ordering.
+        `param_specs` (per-leaf base PartitionSpecs, or None) names
+        axes each leaf KEEPS through gather/scatter (expert leaves)."""
         nbytes = [0]
 
         dep_meta = None if depend is None else \
             (tuple(np.shape(depend)), np.dtype(depend.dtype))
 
-        def one(x):
+        def one(x, spec):
             shape = np.shape(x)
             if not shape:
                 return x
-            ctx = (self._full_sharding(len(shape)),
-                   self._shard_sharding(shape),
+            ctx = (self._full_sharding(len(shape), spec),
+                   self._shard_sharding(shape, spec),
                    self.gather_dtype, np.dtype(x.dtype), dep_meta)
-            nbytes[0] += self._gathered_nbytes(shape, x.dtype)
+            nbytes[0] += self._gathered_nbytes(shape, x.dtype, spec)
             return _gathered_leaf(ctx, x, depend)
 
-        out = jax.tree_util.tree_map(one, tree)
+        if param_specs is None:
+            out = jax.tree_util.tree_map(lambda x: one(x, None), tree)
+        else:
+            out = jax.tree_util.tree_map(one, tree, param_specs)
         if name is not None:
             self._gather_bytes[str(name)] = nbytes[0]
         return out
@@ -247,31 +284,38 @@ class Zero3GatherScheduler:
             prefetch_layers=self.prefetch_layers,
             release_after_use=self.release_after_use)
 
-    def _gather_raw(self, tree):
+    def _gather_raw(self, tree, param_specs=None):
         """Non-differentiated gather used INSIDE the custom-VJP scans
         (their backward is hand-written)."""
-        def one(x):
+        def one(x, spec):
             shape = np.shape(x)
             if not shape:
                 return x
             y = x if self.gather_dtype is None else \
                 x.astype(self.gather_dtype)
             return jax.lax.with_sharding_constraint(
-                y, self._full_sharding(len(shape)))
-        return jax.tree_util.tree_map(one, tree)
+                y, self._full_sharding(len(shape), spec))
+        if param_specs is None:
+            return jax.tree_util.tree_map(lambda x: one(x, None), tree)
+        return jax.tree_util.tree_map(one, tree, param_specs)
 
-    def _scatter_raw(self, ct_tree, like_tree):
+    def _scatter_raw(self, ct_tree, like_tree, param_specs=None):
         """Reduce-scatter a full per-layer cotangent into the owning
-        data-axis shard and cast back to the parameter dtype."""
-        def one(ct, like):
+        data-axis shard (composed on top of the base spec for expert
+        leaves) and cast back to the parameter dtype."""
+        def one(ct, like, spec):
             shape = np.shape(ct)
             if shape:
                 ct = jax.lax.with_sharding_constraint(
-                    ct, self._shard_sharding(shape))
+                    ct, self._shard_sharding(shape, spec))
             if ct.dtype != like.dtype:
                 ct = ct.astype(like.dtype)
             return ct
-        return jax.tree_util.tree_map(one, ct_tree, like_tree)
+        if param_specs is None:
+            return jax.tree_util.tree_map(
+                lambda c, l: one(c, l, None), ct_tree, like_tree)
+        return jax.tree_util.tree_map(one, ct_tree, like_tree,
+                                      param_specs)
 
     # -- the scheduled layer stack --------------------------------------
     @staticmethod
@@ -291,10 +335,29 @@ class Zero3GatherScheduler:
                                                    keepdims=False),
             stacked)
 
-    def _account_stack(self, name, stacked, L):
+    @staticmethod
+    def _layer_specs(param_specs):
+        """Per-layer base specs from STACKED-leaf specs: drop the
+        leading [L] dim entry (never a named axis — the stack dim is
+        what apply_layers slices)."""
+        if param_specs is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda s: PartitionSpec(*tuple(s)[1:]), param_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def _account_stack(self, name, stacked, L, layer_specs=None):
+        if layer_specs is None:
+            spec_leaves = [None] * len(
+                jax.tree_util.tree_leaves(stacked))
+        else:
+            spec_leaves = jax.tree_util.tree_leaves(
+                layer_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
         per_layer = sum(
-            self._gathered_nbytes(np.shape(l)[1:], l.dtype)
-            for l in jax.tree_util.tree_leaves(stacked))
+            self._gathered_nbytes(np.shape(l)[1:], l.dtype, spec)
+            for l, spec in zip(jax.tree_util.tree_leaves(stacked),
+                               spec_leaves))
         window = (min(self.prefetch_layers, L - 1) + 1) \
             if self.release_after_use else L
         self._gather_bytes[str(name)] = per_layer * window
@@ -305,7 +368,7 @@ class Zero3GatherScheduler:
         return per_layer
 
     def apply_layers(self, body, stacked, hidden, rng, extra=(),
-                     name="layers"):
+                     name="layers", param_specs=None):
         """Run `hidden` through L layers of a stacked `[L, ...]` param
         tree under the gather/prefetch/release schedule.
 
@@ -316,6 +379,13 @@ class Zero3GatherScheduler:
         (safe for batch-derived values, which have no param ancestors).
         `rng` is folded per layer (rng_k = fold_in(rng, k)).
 
+        `param_specs` (optional; pytree of base PartitionSpecs matching
+        the STACKED leaves) names mesh axes each leaf keeps through the
+        schedule — the expert-parallel composition: an expert leaf's
+        per-layer gather replicates over data only, its expert dim
+        stays on the `expert` axis, and its cotangent reduce-scatters
+        into the data shard composed on top of that placement.
+
         Forward saves only each layer's input activation (full-layer
         remat); backward re-runs each layer's forward under `jax.vjp`
         with a freshly gathered param copy, in reverse order with
@@ -323,13 +393,17 @@ class Zero3GatherScheduler:
         cotangent into the owning shard before moving on.
         """
         L = self._stack_len(stacked)
-        self._account_stack(name, stacked, L)
+        layer_specs = self._layer_specs(param_specs)
+        self._account_stack(name, stacked, L, layer_specs)
         if not self.release_after_use:
-            return self._upfront_apply(body, stacked, hidden, rng, extra)
+            return self._upfront_apply(body, stacked, hidden, rng,
+                                       extra, param_specs)
         p = min(self.prefetch_layers, L - 1)
         slice_k = self._slice_layer
-        gather = self._gather_raw
-        scatter = self._scatter_raw
+        gather = lambda t: self._gather_raw(t, layer_specs)
+        scatter = lambda ct, like: self._scatter_raw(ct, like,
+                                                     layer_specs)
+        stacked_specs = param_specs
         shard_sharding = self._shard_sharding
 
         # body/rng/extra thread through the custom_vjp as ARGUMENTS:
@@ -367,11 +441,17 @@ class Zero3GatherScheduler:
 
         def run_bwd(res, ct_h):
             stacked, h_ins, rng, ex = res
-            acc0 = jax.tree_util.tree_map(
-                lambda a: jax.lax.with_sharding_constraint(
+
+            def zeros_sharded(a, spec=None):
+                return jax.lax.with_sharding_constraint(
                     jnp.zeros(a.shape, a.dtype),
-                    shard_sharding(a.shape)),
-                stacked)
+                    shard_sharding(a.shape, spec))
+
+            if stacked_specs is None:
+                acc0 = jax.tree_util.tree_map(zeros_sharded, stacked)
+            else:
+                acc0 = jax.tree_util.tree_map(zeros_sharded, stacked,
+                                              stacked_specs)
             win0 = tuple(gather(slice_k(stacked, max(L - 1 - i, 0)))
                          for i in range(p))
 
@@ -403,13 +483,14 @@ class Zero3GatherScheduler:
         run.defvjp(run_fwd, run_bwd)
         return run(stacked, hidden, rng, *extra)
 
-    def _upfront_apply(self, body, stacked, hidden, rng, extra):
+    def _upfront_apply(self, body, stacked, hidden, rng, extra,
+                       param_specs=None):
         """Naive stage-3 baseline: gather the WHOLE stack up front
         (differentiable — its backward materializes the full stacked
         cotangent before one bulk reduce-scatter) and scan over it with
         full-layer remat, so the A/B against the windowed schedule
         isolates the gather strategy."""
-        full = self.gather(stacked)
+        full = self.gather(stacked, param_specs=param_specs)
 
         def step(h, xs):
             k, lp = xs

@@ -5,6 +5,7 @@ TPU-only extras are gated on the backend) in a subprocess and checks
 the output contract."""
 
 import json
+import pytest
 import os
 import subprocess
 import sys
@@ -41,6 +42,7 @@ def test_bench_list_prints_legs():
     assert "elastic_recovery" in legs
     assert "serving_throughput" in legs
     assert "serving_observability" in legs
+    assert "moe_vs_dense" in legs
 
 
 def test_bench_list_and_only_error_agree_with_the_registry():
@@ -69,7 +71,7 @@ def test_bench_list_and_only_error_agree_with_the_registry():
     for leg in ("fused_hot_loop", "pipe_interleave",
                 "numerics_overhead", "memory_ledger", "zero3_overlap",
                 "elastic_recovery", "serving_throughput",
-                "serving_observability"):
+                "serving_observability", "moe_vs_dense"):
         assert leg in registry, leg
 
 
@@ -449,3 +451,30 @@ def test_bench_emits_one_json_line():
         assert plan["params_b"] > 12 and plan["state_gb_per_device"] < 2
     finally:
         os.unlink(d["extras_path"])
+
+
+@pytest.mark.slow
+def test_bench_only_moe_vs_dense_leg():
+    """The MoE iso-step-FLOPs A/B (ISSUE 15) via `--only` on the
+    8-device virtual mesh. The deterministic contracts are asserted
+    INSIDE the leg (grouped-GEMM fwd/grad parity <= 1e-5 vs the
+    unpacked per-expert-loop reference, dropless routing at
+    cf >= 1.25 at production token counts, moe_dispatch ledger ==
+    independent byte math, router-event load fractions summing to 1,
+    the <= 1.3x step-time ratio at 8 experts); the smoke re-checks
+    the recorded flags and the leg's output contract."""
+    proc = _bench_proc("--only", "moe_vs_dense", timeout=540,
+                       devices=8)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "moe_vs_dense"
+    result = d["result"]
+    assert "error" not in result, result
+    assert result["parity_ok"] is True, result
+    assert result["iso_flops_ok"] is True, result
+    assert result["step_time_ratio"] <= 1.3, result
+    assert result["dropless_at_8k_tokens"] is True
+    assert result["param_multiplier"] > 2.0, result
+    router = result["router"]
+    assert router["num_experts"] == 8
+    assert abs(sum(router["expert_load"]) - 1.0) < 1e-3
