@@ -71,6 +71,19 @@ HOT_ENTRYPOINTS = (
     "deepspeed_tpu.moe.experts:grouped_gemm",
     "deepspeed_tpu.moe.experts:ExpertFFN.__call__",
     "deepspeed_tpu.moe.layer:MoEMLP.__call__",
+    # comm/compute overlap runtime (PR 16): fence/tie trace inside
+    # every overlapped step, and schedule() is consulted at trace time
+    # at each site — all pure host dict reads + graph construction,
+    # no rendezvous allowed
+    "deepspeed_tpu.ops.overlap:fence",
+    "deepspeed_tpu.ops.overlap:tie",
+    "deepspeed_tpu.ops.overlap:schedule",
+    # fused MoE dispatch kernels (PR 16): the index-form routing +
+    # gather/scatter pair trace inside every fused MoE step
+    "deepspeed_tpu.moe.router:top_k_gating_indexed",
+    "deepspeed_tpu.moe.fused_dispatch:routing_slots",
+    "deepspeed_tpu.moe.fused_dispatch:fused_dispatch",
+    "deepspeed_tpu.moe.fused_dispatch:fused_combine",
 )
 
 # ----------------------------------------------------------------------

@@ -227,6 +227,24 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("mixture of experts", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.ops import overlap as _overlap
+        rows.append((
+            "comm/compute overlap",
+            f"{SUCCESS} async-collective scheduling at "
+            f"{', '.join(_overlap.SITES)} (overlap block; "
+            "bench.py --only comm_overlap; docs/overlap.md)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("comm/compute overlap", f"{FAIL} {e}"))
+    try:
+        from deepspeed_tpu.moe.fused_dispatch import fused_dispatch  # noqa: F401,E501
+        rows.append((
+            "fused MoE dispatch",
+            f"{SUCCESS} Pallas gather-scatter dispatch/combine "
+            "kernels over capacity-indexed rows (moe.fused_dispatch; "
+            "bench.py --only moe_dispatch_kernel)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("fused MoE dispatch", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.analysis.rules import ALL_RULES
         from deepspeed_tpu.analysis import baseline as _bl
         bl_path = _bl.default_path(os.path.dirname(

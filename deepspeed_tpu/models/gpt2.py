@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
+from deepspeed_tpu.ops import overlap as _overlap
 from deepspeed_tpu.runtime.mesh import EXPERT_AXIS, MODEL_AXIS
 
 
@@ -702,6 +703,22 @@ def chunked_tied_head_loss(hidden, wte, labels, ignore_index=-100,
     return total / jnp.maximum(count, 1)
 
 
+def _zero3_leaf_depend(sched, tree, hidden):
+    """`depend=` for a ZeRO-3 standalone-leaf gather under the
+    `zero3_leaf` overlap site (ops/overlap.py): tying the gather to
+    the post-embed activation sinks its all-gather under the first
+    scan layers instead of serializing at the program top. None when
+    the site is off — the PR-9 up-front gather, bit-exact either way
+    (the fence is a schedule constraint, not math)."""
+    nbytes = sum(
+        int(np.prod(np.shape(leaf))) * np.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree))
+    on = _overlap.schedule(_overlap.SITE_ZERO3_LEAF,
+                           payload_bytes=nbytes,
+                           mesh=sched.mesh)["overlap"]
+    return hidden if on else None
+
+
 def cross_entropy_loss(logits, labels, ignore_index=-100):
     """Token-level CE in fp32; mean over non-ignored positions."""
     logits = logits.astype(jnp.float32)
@@ -754,14 +771,14 @@ class GPT2ForCausalLM:
     def configure_moe(self, mesh=None, num_experts=None,
                       every_n_layers=None, top_k=None,
                       capacity_factor=None, aux_loss_weight=None,
-                      jitter_eps=None):
+                      jitter_eps=None, fused_dispatch=None):
         """Engine hook for the `moe` config block. Structural keys
         (num_experts, every_n_layers) are VERIFIED against the built
         model — they shape the parameter tree, so a mismatch is a
         config error, not a rebuild. Router knobs (top_k,
-        capacity_factor, aux_loss_weight, jitter_eps) and the engine
-        mesh are applied: they are trace-time behavior, the parameter
-        tree is identical before and after."""
+        capacity_factor, aux_loss_weight, jitter_eps, fused_dispatch)
+        and the engine mesh are applied: they are trace-time behavior,
+        the parameter tree is identical before and after."""
         moe = self.config.moe
         if moe is None:
             raise ValueError(
@@ -788,6 +805,8 @@ class GPT2ForCausalLM:
             updates["aux_loss_weight"] = float(aux_loss_weight)
         if jitter_eps is not None:
             updates["jitter_eps"] = float(jitter_eps)
+        if fused_dispatch is not None:
+            updates["fused_dispatch"] = fused_dispatch
         moe = dataclasses.replace(moe, **updates).validate()
         self.config = dataclasses.replace(self.config, moe=moe)
         self.module = GPT2LMHeadModel(self.config)
@@ -950,7 +969,9 @@ class GPT2ForCausalLM:
         stacked = params["h"]
         cell = _MoECellScan(cfg)
         base_rng = (rngs or {}).get("dropout", jax.random.PRNGKey(0))
-        lnf_params = sched.gather(params["ln_f"], name="ln_f")
+        lnf_params = sched.gather(
+            params["ln_f"], name="ln_f",
+            depend=_zero3_leaf_depend(sched, params["ln_f"], hidden))
 
         def body(lp, carry, rng_k):
             out, _ = cell.apply({"params": lp}, carry, deterministic)
@@ -1010,7 +1031,9 @@ class GPT2ForCausalLM:
         # inactive here by the _zero3_active gate) so scheduled and
         # unscheduled traces run the same op sequence
         use_boundary = resolve_fused_ops(cfg.fused_ops, True)
-        lnf_params = sched.gather(params["ln_f"], name="ln_f")
+        lnf_params = sched.gather(
+            params["ln_f"], name="ln_f",
+            depend=_zero3_leaf_depend(sched, params["ln_f"], hidden))
 
         if use_boundary:
             def body(lp, carry, rng_k):

@@ -19,6 +19,11 @@ device-side and drain at the existing monitor fence.
   dispatch.py  dispatch/combine einsum pair + sharding constraints +
                the trace-time byte accounting the `moe_dispatch`
                memory-ledger category samples
+  fused_dispatch.py  the fused gather-scatter replacement for the
+               einsum pair on expert-local meshes: Pallas
+               scalar-prefetch kernels + an XLA take/segment-sum
+               fallback sharing one custom VJP (`moe.fused_dispatch`
+               config knob; ops/overlap.py schedules the pair)
   experts.py   expert FFNs as grouped GEMMs — pairs of experts packed
                block-diagonally so each GEMM contracts over 2*K (the
                PR-4 flash-attention packing trick's second user), with
@@ -36,15 +41,22 @@ ZeRO-3 / elasticity composition contract.
 from deepspeed_tpu.moe.dispatch import (dispatch_bytes_per_layer,
                                         reset_dispatch_accounting)
 from deepspeed_tpu.moe.experts import ExpertFFN, grouped_gemm
+from deepspeed_tpu.moe.fused_dispatch import (fused_combine,
+                                              fused_dispatch,
+                                              routing_slots)
 from deepspeed_tpu.moe.layer import (MoEConfig, MoEMLP,
                                      moe_mlp_reference,
+                                     resolve_fused_dispatch,
                                      resolve_pack_experts)
 from deepspeed_tpu.moe.router import (router_capacity, top_k_gating,
+                                      top_k_gating_indexed,
                                       STAT_AUX, STAT_DROP)
 
 __all__ = [
     "MoEConfig", "MoEMLP", "ExpertFFN", "grouped_gemm",
-    "moe_mlp_reference", "resolve_pack_experts", "router_capacity",
-    "top_k_gating", "dispatch_bytes_per_layer",
+    "moe_mlp_reference", "resolve_pack_experts",
+    "resolve_fused_dispatch", "router_capacity",
+    "top_k_gating", "top_k_gating_indexed", "fused_dispatch",
+    "fused_combine", "routing_slots", "dispatch_bytes_per_layer",
     "reset_dispatch_accounting", "STAT_AUX", "STAT_DROP",
 ]

@@ -91,15 +91,38 @@ def _mesh_active(mesh):
     return EXPERT_AXIS in getattr(mesh, "axis_names", ())
 
 
-def dispatch_tokens(x, dispatch_mask, mesh=None):
+def dispatch_tokens(x, dispatch_mask, mesh=None, granularity=1):
     """[N, H] tokens -> [E, C, H] per-expert buffers (the dispatch
-    all-to-all). `dispatch_mask` [N, E, C] from top_k_gating."""
-    xe = jnp.einsum("nec,nh->ech",
-                    dispatch_mask.astype(x.dtype), x)
-    if _mesh_active(mesh):
-        xe = jax.lax.with_sharding_constraint(
-            xe, _expert_sharding(mesh, xe.ndim))
-    return xe
+    all-to-all). `dispatch_mask` [N, E, C] from top_k_gating.
+
+    `granularity` > 1 splits the einsum + constraint along the
+    capacity axis into that many contiguous chunks, each an
+    independently issued collective XLA can pipeline against the
+    expert compute (the autotuned `moe_dispatch` schedule knob,
+    ops/overlap.py). BIT-EXACT: the token contraction is untouched and
+    the chunks are disjoint slices of the output, so the concat
+    reassembles the single-einsum result exactly."""
+    c = dispatch_mask.shape[-1]
+    g = max(int(granularity), 1)
+    if g <= 1 or c < g:
+        xe = jnp.einsum("nec,nh->ech",
+                        dispatch_mask.astype(x.dtype), x)
+        if _mesh_active(mesh):
+            xe = jax.lax.with_sharding_constraint(
+                xe, _expert_sharding(mesh, xe.ndim))
+        return xe
+    sizes = [c // g + (1 if i < c % g else 0) for i in range(g)]
+    chunks, lo = [], 0
+    for sz in sizes:
+        xe_c = jnp.einsum(
+            "nec,nh->ech",
+            dispatch_mask[:, :, lo:lo + sz].astype(x.dtype), x)
+        if _mesh_active(mesh):
+            xe_c = jax.lax.with_sharding_constraint(
+                xe_c, _expert_sharding(mesh, xe_c.ndim))
+        chunks.append(xe_c)
+        lo += sz
+    return jnp.concatenate(chunks, axis=1)
 
 
 def combine_tokens(ye, combine_weights, mesh=None):

@@ -23,13 +23,18 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.moe.dispatch import (combine_tokens,
+from deepspeed_tpu.moe.dispatch import (_mesh_active, combine_tokens,
                                         dispatch_buffer_nbytes,
                                         dispatch_tokens,
                                         record_dispatch_bytes,
                                         replicate_stats)
 from deepspeed_tpu.moe.experts import ExpertFFN, expert_ffn_reference
-from deepspeed_tpu.moe.router import router_capacity, top_k_gating
+from deepspeed_tpu.moe.fused_dispatch import (fused_combine,
+                                              fused_dispatch,
+                                              routing_slots)
+from deepspeed_tpu.moe.router import (router_capacity, top_k_gating,
+                                      top_k_gating_indexed)
+from deepspeed_tpu.ops import overlap as _overlap
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,7 +56,12 @@ class MoEConfig:
     packs on real TPU only, the quantized-compute "auto" precedent:
     the packing trick exists to fill the MXU's 128-wide contraction
     lanes, while on XLA-CPU the traced block-diagonal assembly is
-    pure overhead)."""
+    pure overhead). `fused_dispatch` ("off"|"on"|"auto") swaps the
+    one-hot dispatch/combine einsum pair for the fused gather-scatter
+    kernels (moe/fused_dispatch.py); the fused path is local
+    gather/scatter math, so "on" refuses expert-parallel meshes
+    (their all-to-all IS the einsum pair's sharding constraint) and
+    "auto" fuses only on real TPU without an expert axis."""
     num_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
@@ -61,6 +71,7 @@ class MoEConfig:
     quantized_experts: str = "off"
     quant_block: int = 128
     pack_experts: Any = "auto"
+    fused_dispatch: Any = "auto"
     mesh: Any = None
 
     def validate(self):
@@ -86,6 +97,18 @@ class MoEConfig:
             raise ValueError(
                 "moe.pack_experts must be True, False or 'auto', got "
                 f"{self.pack_experts!r}")
+        if self.fused_dispatch not in (True, False, "on", "off",
+                                       "auto"):
+            raise ValueError(
+                "moe.fused_dispatch must be 'on', 'off' or 'auto', "
+                f"got {self.fused_dispatch!r}")
+        if self.fused_dispatch in (True, "on") and \
+                _mesh_active(self.mesh):
+            raise ValueError(
+                "moe.fused_dispatch='on' is incompatible with an "
+                "expert-parallel mesh: the einsum pair's sharding "
+                "constraints are the all-to-all there; use 'auto' or "
+                "'off'")
         return self
 
 
@@ -100,6 +123,27 @@ def resolve_pack_experts(mode):
         return jax.devices()[0].platform == "tpu"
     raise ValueError(
         f"pack_experts must be True, False or 'auto', got {mode!r}")
+
+
+def resolve_fused_dispatch(mode, mesh=None):
+    """`fused_dispatch` -> bool at trace time. "on"/True force the
+    fused gather-scatter path (refused on expert-parallel meshes —
+    validate() catches that earlier; re-checked here for direct
+    callers); "auto" fuses on real TPU when no expert axis shards the
+    dispatch buffers (the GSPMD einsum pair owns those meshes)."""
+    if mode in (False, "off"):
+        return False
+    if mode in (True, "on"):
+        if _mesh_active(mesh):
+            raise ValueError(
+                "fused_dispatch='on' is incompatible with an "
+                "expert-parallel mesh (see MoEConfig.validate)")
+        return True
+    if mode == "auto":
+        return jax.devices()[0].platform == "tpu" and \
+            not _mesh_active(mesh)
+    raise ValueError(
+        f"fused_dispatch must be 'on', 'off' or 'auto', got {mode!r}")
 
 
 class MoEMLP(nn.Module):
@@ -134,17 +178,43 @@ class MoEMLP(nn.Module):
             rng = self.make_rng("dropout")
         capacity = router_capacity(n, moe.num_experts, moe.top_k,
                                    moe.capacity_factor)
-        dispatch, combine, stats = top_k_gating(
-            logits, moe.top_k, capacity, rng=rng,
-            jitter_eps=moe.jitter_eps)
+        # overlap schedule for the dispatch/combine pair: a pure
+        # host-side read (explicit config > autotuned table > default;
+        # ops/overlap.py). The payload class is the UNSHARDED buffer
+        # bytes so init-time and engine traces agree.
+        sched = _overlap.schedule(
+            _overlap.SITE_MOE,
+            payload_bytes=dispatch_buffer_nbytes(
+                moe.num_experts, capacity, h, self.dtype, None),
+            mesh=moe.mesh)
+        fused = resolve_fused_dispatch(moe.fused_dispatch, moe.mesh)
+        if fused:
+            routing, stats = top_k_gating_indexed(
+                logits, moe.top_k, capacity, rng=rng,
+                jitter_eps=moe.jitter_eps)
+        else:
+            dispatch, combine, stats = top_k_gating(
+                logits, moe.top_k, capacity, rng=rng,
+                jitter_eps=moe.jitter_eps)
         # stats must stay replicated: the dispatched tensor's
         # (expert, data) sharding otherwise back-propagates into the
         # gating reductions and leaves per-shard PARTIAL sums (a
         # dp-times-too-large fetched vector; see replicate_stats)
         stats = replicate_stats(stats, moe.mesh)
 
-        xe = dispatch_tokens(xf.astype(self.dtype), dispatch,
-                             mesh=moe.mesh)
+        if fused:
+            src, dest = routing_slots(routing, moe.num_experts,
+                                      capacity)
+            xe = fused_dispatch(xf.astype(self.dtype), src).reshape(
+                moe.num_experts, capacity, h)
+        else:
+            xe = dispatch_tokens(xf.astype(self.dtype), dispatch,
+                                 mesh=moe.mesh,
+                                 granularity=sched["granularity"])
+        if sched["overlap"]:
+            # issue-early: the dispatch all-to-all (or gather) flies
+            # while the router stats/aux epilogue computes
+            xe, stats = _overlap.async_collective(xe, stats)
         ye = ExpertFFN(
             num_experts=moe.num_experts, d_model=h, d_ff=self.d_ff,
             dtype=self.dtype, param_dtype=self.param_dtype,
@@ -163,7 +233,26 @@ class MoEMLP(nn.Module):
             dispatch_buffer_nbytes(moe.num_experts, capacity, h,
                                    self.dtype, None),
             num_experts=moe.num_experts, width=h)
-        y = combine_tokens(ye, combine, mesh=moe.mesh)
+        # in-flight window for the `overlap_inflight` ledger category:
+        # the send + recv staging pair stays live across the overlap
+        # region (0 when the site is not overlapped). PER-DEVICE bytes
+        # — the mesh is known here; keyed so re-traces overwrite.
+        _overlap.record_inflight(
+            _overlap.SITE_MOE, "/".join(self.path),
+            dispatch_buffer_nbytes(moe.num_experts, capacity, h,
+                                   self.dtype, moe.mesh)
+            if sched["overlap"] else 0)
+        if fused:
+            y = fused_combine(
+                ye.reshape(moe.num_experts * capacity, h), dest,
+                routing["keep"], routing["w"])
+        else:
+            y = combine_tokens(ye, combine, mesh=moe.mesh)
+        if sched["overlap"]:
+            # consume-late: the combined tokens release together with
+            # the epilogue group, so the caller's post-expert residual
+            # can overlap the combine collective
+            y = _overlap.overlap_fence(y, stats)
         return y.reshape(b, t, h).astype(self.dtype), stats
 
 

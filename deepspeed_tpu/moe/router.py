@@ -62,6 +62,55 @@ def _jitter(logits, rng, eps):
     return logits * noise
 
 
+def _gating_core(logits, top_k, capacity, rng, jitter_eps):
+    """Shared gating math behind both routing forms: softmax + top-k +
+    renorm + the choice-major capacity assignment, returned PER CHOICE
+    (fits [N, E] 0/1, slot [N]) plus the stats vector. The dense and
+    index forms below are pure reshapes of this — identical priority
+    and drop semantics by construction."""
+    n, e = logits.shape
+    k = int(top_k)
+    if not 1 <= k <= e:
+        raise ValueError(f"top_k must be in [1, {e}], got {top_k}")
+    logits = logits.astype(jnp.float32)
+    if rng is not None and jitter_eps > 0.0:
+        logits = _jitter(logits, rng, float(jitter_eps))
+    probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
+
+    gate_vals, gate_idx = jax.lax.top_k(probs, k)      # [N, k]
+    # renormalize over the selected k (GShard; k=1 leaves probs as-is)
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # choice-major capacity assignment: all first choices outrank all
+    # second choices; within a choice, token order breaks ties
+    masks = [jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.float32)
+             for j in range(k)]                        # k x [N, E]
+    taken = jnp.zeros((e,), jnp.float32)               # slots consumed
+    fits_list, slot_list = [], []
+    kept = jnp.float32(0.0)
+    for _j, mask in enumerate(masks):
+        pos = jnp.cumsum(mask, axis=0) - 1.0 + taken[None, :]  # [N, E]
+        fits = mask * (pos < capacity)
+        slot = jnp.sum(fits * pos, axis=-1).astype(jnp.int32)  # [N]
+        fits_list.append(fits)
+        slot_list.append(slot)
+        kept = kept + jnp.sum(fits)
+        taken = taken + jnp.sum(mask, axis=0)
+
+    # aux loss: f_e from first choices (counts), P_e differentiable
+    f_e = jnp.mean(jax.lax.stop_gradient(masks[0]), axis=0)
+    p_e = jnp.mean(probs, axis=0)
+    aux = jnp.float32(e) * jnp.sum(f_e * p_e)
+
+    load = jnp.sum(jax.lax.stop_gradient(sum(masks)), axis=0) \
+        / jnp.float32(n * k)
+    dropped = 1.0 - kept / jnp.float32(n * k)
+    stats = jnp.concatenate(
+        [load, jnp.stack([jax.lax.stop_gradient(dropped), aux])])
+    return gate_vals, gate_idx, fits_list, slot_list, stats
+
+
 def top_k_gating(logits, top_k, capacity, rng=None, jitter_eps=0.0):
     """Routing decision for one batch of token logits.
 
@@ -83,52 +132,50 @@ def top_k_gating(logits, top_k, capacity, rng=None, jitter_eps=0.0):
         matching the Switch estimator).
     """
     n, e = logits.shape
-    k = int(top_k)
-    if not 1 <= k <= e:
-        raise ValueError(f"top_k must be in [1, {e}], got {top_k}")
-    logits = logits.astype(jnp.float32)
-    if rng is not None and jitter_eps > 0.0:
-        logits = _jitter(logits, rng, float(jitter_eps))
-    probs = jax.nn.softmax(logits, axis=-1)            # [N, E]
-
-    gate_vals, gate_idx = jax.lax.top_k(probs, k)      # [N, k]
-    # renormalize over the selected k (GShard; k=1 leaves probs as-is)
-    gate_vals = gate_vals / jnp.maximum(
-        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
-
-    # choice-major capacity assignment: all first choices outrank all
-    # second choices; within a choice, token order breaks ties
-    masks = [jax.nn.one_hot(gate_idx[:, j], e, dtype=jnp.float32)
-             for j in range(k)]                        # k x [N, E]
-    taken = jnp.zeros((e,), jnp.float32)               # slots consumed
+    gate_vals, _gate_idx, fits_list, slot_list, stats = _gating_core(
+        logits, top_k, capacity, rng, jitter_eps)
     dispatch = jnp.zeros((n, e, capacity), jnp.float32)
     combine = jnp.zeros((n, e, capacity), jnp.float32)
-    kept = jnp.float32(0.0)
-    for j, mask in enumerate(masks):
-        pos = jnp.cumsum(mask, axis=0) - 1.0 + taken[None, :]  # [N, E]
-        fits = mask * (pos < capacity)
-        slot = jnp.sum(fits * pos, axis=-1).astype(jnp.int32)  # [N]
+    for j, (fits, slot) in enumerate(zip(fits_list, slot_list)):
         onehot_c = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
         d_j = fits[:, :, None] * onehot_c[:, None, :]
         dispatch = dispatch + d_j
         combine = combine + d_j * gate_vals[:, j, None, None]
-        kept = kept + jnp.sum(fits)
-        taken = taken + jnp.sum(mask, axis=0)
 
     # the mask half is integer-derived (one-hots of top-k indices) —
     # no gradient path exists through it; the combine weight is
     # differentiable through the renormalized gate prob only, the
     # standard Switch/GShard estimator
     dispatch = jax.lax.stop_gradient(dispatch)
-
-    # aux loss: f_e from first choices (counts), P_e differentiable
-    f_e = jnp.mean(jax.lax.stop_gradient(masks[0]), axis=0)
-    p_e = jnp.mean(probs, axis=0)
-    aux = jnp.float32(e) * jnp.sum(f_e * p_e)
-
-    load = jnp.sum(jax.lax.stop_gradient(sum(masks)), axis=0) \
-        / jnp.float32(n * k)
-    dropped = 1.0 - kept / jnp.float32(n * k)
-    stats = jnp.concatenate(
-        [load, jnp.stack([jax.lax.stop_gradient(dropped), aux])])
     return dispatch, combine, stats
+
+
+def top_k_gating_indexed(logits, top_k, capacity, rng=None,
+                         jitter_eps=0.0):
+    """Index-form routing decision: the same gating as `top_k_gating`
+    WITHOUT materializing the O(N*E*C) one-hot dispatch/combine
+    tensors — what the fused gather/scatter dispatch kernel consumes
+    (moe/fused_dispatch.py).
+
+    Returns (routing, stats); `routing` is a dict of [N, k] arrays:
+      e_idx  int32 — expert of choice j (top-k order);
+      slot   int32 — capacity slot owned by the assignment (only
+             meaningful where keep == 1);
+      keep   f32 0/1 — assignment survived the capacity cut
+             (stop-gradiented, like the dense dispatch mask);
+      w      f32 — renormalized gate prob (the differentiable half).
+    The dense masks are exactly `scatter(keep * one_hot(slot))` of
+    these — parity is pinned in tests/test_moe.py."""
+    gate_vals, gate_idx, fits_list, slot_list, stats = _gating_core(
+        logits, top_k, capacity, rng, jitter_eps)
+    # fits rows hold at most one 1 (at column e_idx[:, j]) — the sum
+    # over experts is the 0/1 keep flag of that choice
+    keep = jnp.stack([jnp.sum(f, axis=-1) for f in fits_list], axis=-1)
+    slot = jnp.stack(slot_list, axis=-1)
+    routing = {
+        "e_idx": gate_idx.astype(jnp.int32),
+        "slot": jax.lax.stop_gradient(slot),
+        "keep": jax.lax.stop_gradient(keep),
+        "w": gate_vals,
+    }
+    return routing, stats

@@ -74,6 +74,7 @@ CAT_PREFETCH = "prefetch"
 CAT_PIPE = "pipe_buffers"
 CAT_KV = "kv_cache"
 CAT_MOE = "moe_dispatch"
+CAT_OVERLAP = "overlap_inflight"
 
 # canonical ordering for stacked rendering (Perfetto counter tracks,
 # event dicts): state groups first, transients last (zero3_gather —
@@ -83,10 +84,15 @@ CAT_MOE = "moe_dispatch"
 # the pool is resident for the engine's lifetime, with per-request
 # entries carving it up; moe_dispatch — the MoE layers' all-to-all
 # send/recv capacity buffers [E, C, H] — is per-step working memory
-# like zero3_gather: a DYNAMIC entry learned at first trace)
+# like zero3_gather: a DYNAMIC entry learned at first trace;
+# overlap_inflight — the comm/compute overlap runtime's in-flight
+# collective staging windows (MoE dispatch pair + ring send/recv
+# rotations, ops/overlap.py) — likewise: per-step working memory that
+# scales with overlap.issue_distance)
 CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS, CAT_ZERO3,
-              CAT_MOE, CAT_KV, CAT_HOST_MASTER, CAT_HOST_OPT,
-              CAT_WIRE, CAT_CKPT, CAT_PREFETCH, CAT_PIPE)
+              CAT_MOE, CAT_OVERLAP, CAT_KV, CAT_HOST_MASTER,
+              CAT_HOST_OPT, CAT_WIRE, CAT_CKPT, CAT_PREFETCH,
+              CAT_PIPE)
 
 
 # ----------------------------------------------------------------------
@@ -444,6 +450,17 @@ def oom_hints(payload):
             "or raise moe.num_experts only together with the mesh "
             "expert axis (per-device buffer bytes scale with "
             "num_experts / expert-axis size)")
+    if cats.get(CAT_OVERLAP) and ledger and \
+            cats[CAT_OVERLAP] > 0.15 * ledger:
+        hints.append(
+            "comm/compute overlap in-flight staging (MoE dispatch "
+            "window + ring send/recv rotations) holds "
+            f"{cats[CAT_OVERLAP] / 2**30:.2f} GiB of "
+            f"{ledger / 2**30:.2f} GiB ledgered: lower "
+            "overlap.issue_distance (the ring window scales linearly "
+            "with it), pin overlap.sites to fewer sites, or set "
+            '"overlap": {"enabled": false} to trade the hidden '
+            "collective latency back for the staging bytes")
     if cats.get(CAT_KV) and ledger and \
             cats[CAT_KV] > 0.3 * ledger:
         hints.append(

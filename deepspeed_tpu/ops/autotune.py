@@ -45,7 +45,11 @@ import time
 
 from deepspeed_tpu.utils.logging import logger
 
-TABLE_VERSION = 1
+# v2: adds the collective-schedule family (overlap on/off, issue
+# distance, dispatch granularity per site/mesh/payload class) and the
+# fused MoE dispatch kernel family. v1 tables are ignored with one
+# warning and repopulate on the next search.
+TABLE_VERSION = 2
 TABLE_BASENAME = f"autotune_table_v{TABLE_VERSION}.json"
 
 # kernel family -> defining module (its source hash invalidates the
@@ -58,6 +62,10 @@ KERNEL_MODULES = {
     "fused_gelu": "deepspeed_tpu.ops.transformer.fused_ops",
     "quantized_matmul":
         "deepspeed_tpu.ops.transformer.quantized_matmul",
+    "moe_dispatch": "deepspeed_tpu.moe.fused_dispatch",
+    # collective-schedule entries describe the overlap runtime's
+    # behavior, so its module source is the invalidation key
+    "collective_schedule": "deepspeed_tpu.ops.overlap",
 }
 
 _lock = threading.Lock()
@@ -440,3 +448,69 @@ def qmm_blocks(m, k, n, dtype):
     if not bm or not bn:
         return None
     return int(bm), int(bn)
+
+
+# ----------------------------------------------------------------------
+# collective-schedule family: per-(site, mesh-shape, payload-bytes)
+# overlap variants, searched with the same never-slower discipline and
+# persisted in the same versioned table as the block shapes. Consulted
+# by ops/overlap.py `schedule()` when `overlap.sites == "auto"`.
+# ----------------------------------------------------------------------
+# entries are schedules, not kernels: this string fills the key's
+# dtype slot (_dtype_str passes non-dtypes through verbatim)
+COLLECTIVE_DTYPE = "schedule"
+
+COLLECTIVE_DEFAULT = {"overlap": True, "issue_distance": 1,
+                      "granularity": 1}
+
+
+def mesh_shape_class(mesh):
+    """Axis-signature string for a mesh ("p1.d8.e1.m1"); accepts a jax
+    Mesh, a {name: size} dict, or None ("nomesh")."""
+    if mesh is None:
+        return "nomesh"
+    try:
+        items = list(mesh.shape.items())
+    except AttributeError:
+        items = list(dict(mesh).items())
+    return ".".join(f"{str(n)[:1]}{int(s)}" for n, s in items) or "nomesh"
+
+
+def collective_shape_class(site, mesh, payload_bytes):
+    """Shape class for a collective site: mesh axis signature plus the
+    pow2 KiB bucket of the per-shard payload."""
+    kb = pow2_bucket(max(int(payload_bytes), 1024) // 1024)
+    return f"{site}|{mesh_shape_class(mesh)}|kb{kb}"
+
+
+def collective_candidates(site):
+    """Schedule candidates per site. MoE varies dispatch granularity,
+    ring varies how many permutes stay in flight, the ZeRO-3 leaf
+    fence is a pure on/off decision."""
+    if site == "moe_dispatch":
+        return [{"overlap": o, "issue_distance": 1, "granularity": g}
+                for o in (True, False) for g in (1, 2, 4)]
+    if site == "ring":
+        return [{"overlap": o, "issue_distance": d, "granularity": 1}
+                for o in (True, False) for d in (1, 2)]
+    return [{"overlap": o, "issue_distance": 1, "granularity": 1}
+            for o in (True, False)]
+
+
+def collective_schedule(site, mesh, payload_bytes):
+    """Tuned schedule params for a collective site, or None."""
+    return lookup("collective_schedule",
+                  collective_shape_class(site, mesh, payload_bytes),
+                  COLLECTIVE_DTYPE)
+
+
+def search_collective_schedule(site, mesh, payload_bytes, measure,
+                               backend=None, persist=True):
+    """Search the schedule variants for one site with `measure(params)
+    -> seconds`. The un-tuned behavior (overlap on, distance 1,
+    granularity 1) is the default and the never-slower floor."""
+    return search("collective_schedule",
+                  collective_shape_class(site, mesh, payload_bytes),
+                  COLLECTIVE_DTYPE, collective_candidates(site),
+                  dict(COLLECTIVE_DEFAULT), measure=measure,
+                  backend=backend, persist=persist)

@@ -1,0 +1,270 @@
+"""Communication/compute overlap runtime: the ONE home of the
+overlap discipline.
+
+The repo's collectives are declarative (GSPMD sharding constraints
+lower to all-to-all / all-gather; `ppermute` inside shard_map is the
+ring hop), which leaves *when* a collective issues entirely to the
+XLA latency-hiding scheduler.  PR-9 taught one site — the ZeRO-3
+gather — to phrase its schedule explicitly with
+`jax.lax.optimization_barrier`: issue the collective early (tied so it
+cannot hoist above the producer that makes issuing legal), consume it
+late (tied so the consumer cannot sink the issue to just before the
+use).  This module generalizes that pattern into shared primitives and
+applies one consistent discipline at every overlap site:
+
+  * ``tie(*trees)`` / ``async_collective(collective, compute)`` — one
+    `optimization_barrier` across all leaves: every output depends on
+    every input, so an issued async collective and the compute meant
+    to hide it reach the scheduler as one co-scheduled group.  XLA
+    starts the collective, runs the compute while it flies, and only
+    then releases either result downstream.
+  * ``fence(value, *deps)`` (alias ``overlap_fence``) — the one-way
+    form: ``value`` cannot be hoisted above any dep; the deps' barrier
+    outputs are discarded.  This is the exact PR-9 ZeRO-3 fence —
+    `runtime/zero/stage3.py` and `runtime/pipe/engine.py` now import
+    it from here rather than each open-coding the barrier.
+
+Both are bit-exact identities on values: the barrier constrains the
+schedule, never the math.  Every parity test in tests/test_overlap.py
+asserts bit-exact equality between scheduled and unscheduled runs.
+
+Sites (the names accepted by ``overlap.sites`` and keyed in the
+autotune collective-schedule table):
+
+  * ``moe_dispatch`` — the MoE all-to-all pair (moe/dispatch.py): the
+    dispatch all-to-all is co-scheduled with the router stats/aux
+    epilogue so it issues while the gate epilogue computes; the
+    combine all-to-all is fenced so the post-expert residual can
+    overlap it.  ``granularity`` > 1 splits the dispatch/combine
+    einsum along the capacity axis into that many independently
+    scheduled chunks (bit-exact: the token contraction is untouched).
+  * ``ring`` — ring-attention send/recv (ops/sequence/): chunk k+1's
+    `ppermute` issues before chunk k's flash-merge consumes, with
+    ``issue_distance`` controlling how many rotations stay in flight.
+  * ``zero3_leaf`` — ZeRO-3 standalone-leaf gathers (ln_f in the
+    models' loss closures): gathered with ``depend=`` on the embedded
+    activations so the gather issues under the first scan layers
+    instead of serializing up-front.
+
+Schedule resolution (``schedule(site, ...)``, a pure host-side dict
+read at trace time — no device sync, HOTSYNC-safe):
+
+  1. global ``overlap.enabled`` off -> overlap off everywhere;
+  2. explicit ``overlap.sites`` list -> overlap on exactly those
+     sites, with the configured ``overlap.issue_distance``;
+  3. ``sites="auto"`` (default) -> consult the autotune
+     collective-schedule table (per site / mesh shape / payload-bytes
+     bucket, never-slower by construction — see ops/autotune.py),
+     falling back to overlap ON with the configured issue distance.
+
+In-flight byte accounting: each site registers its per-device staging
+window (``record_inflight``) at trace time; the engine exposes the sum
+of per-site maxima as the ``overlap_inflight`` memory-ledger category
+(docs/monitoring.md) so `oom_hints` can name ``overlap.issue_distance``
+when the in-flight window dominates.
+"""
+
+import threading
+
+import jax
+
+SITE_MOE = "moe_dispatch"
+SITE_RING = "ring"
+SITE_ZERO3_LEAF = "zero3_leaf"
+SITES = (SITE_MOE, SITE_RING, SITE_ZERO3_LEAF)
+
+DEFAULT_ISSUE_DISTANCE = 1
+
+_lock = threading.Lock()
+_state = {
+    "enabled": True,
+    "sites": "auto",     # "auto" | frozenset of SITES members
+    "issue_distance": DEFAULT_ISSUE_DISTANCE,
+    "inflight": {},      # (site, key) -> per-device staging bytes
+}
+
+
+# ----------------------------------------------------------------------
+# primitives
+# ----------------------------------------------------------------------
+@jax.custom_vjp
+def _barrier(leaves):
+    """optimization_barrier behind a pass-through VJP: the lax op has
+    no differentiation rule, and the fences sit on differentiated loss
+    paths (the MoE dispatch tie). The barrier is an identity, so the
+    cotangents pass straight through — the *backward* schedule is
+    constrained by its own sites' fences, not by replaying forward
+    ones."""
+    return jax.lax.optimization_barrier(leaves)
+
+
+def _barrier_fwd(leaves):
+    return _barrier(leaves), None
+
+
+def _barrier_bwd(_res, cts):
+    return (cts,)
+
+
+_barrier.defvjp(_barrier_fwd, _barrier_bwd)
+
+
+def tie(*trees):
+    """One `optimization_barrier` across every leaf of every tree:
+    each returned tree depends on ALL inputs, so XLA can neither hoist
+    one past the others nor sink any input's producer below the group.
+    Bit-exact identity on values. Returns the tied trees (a single
+    tree when called with one argument, else a tuple)."""
+    flat, treedef = jax.tree_util.tree_flatten(tuple(trees))
+    if not flat:
+        return trees[0] if len(trees) == 1 else trees
+    out = _barrier(tuple(flat))
+    tied = jax.tree_util.tree_unflatten(treedef, out)
+    return tied[0] if len(trees) == 1 else tied
+
+
+def fence(value, *deps):
+    """One-way fence: `value`'s returned copy cannot be hoisted above
+    any of `deps` (the deps' barrier outputs are discarded, so their
+    own consumers are unconstrained). None deps are ignored; with no
+    live deps the value passes through untouched. This is the PR-9
+    ZeRO-3 gather fence, shared."""
+    live = [d for d in deps if d is not None]
+    if not live:
+        return value
+    v_leaves, v_def = jax.tree_util.tree_flatten(value)
+    d_leaves, _ = jax.tree_util.tree_flatten(tuple(live))
+    if not v_leaves or not d_leaves:
+        return value
+    out = _barrier(tuple(v_leaves) + tuple(d_leaves))
+    return jax.tree_util.tree_unflatten(v_def, out[:len(v_leaves)])
+
+
+# The issue's spelling for the same primitive: sites that phrase their
+# schedule as "this may not start before that" use the fence name.
+overlap_fence = fence
+
+
+def async_collective(collective, compute):
+    """Co-schedule an issued collective with the compute meant to hide
+    it: returns ``(collective', compute')`` mutually tied, so the
+    collective is issued no later than the compute group and neither
+    result releases downstream until both exist. The async collective
+    flies while the compute runs — issue-early/consume-late in one
+    call. Bit-exact identity on both values."""
+    return tie(collective, compute)
+
+
+# ----------------------------------------------------------------------
+# configuration (engine wiring; autotune-style process-global state)
+# ----------------------------------------------------------------------
+def _normalize_sites(sites):
+    if isinstance(sites, str):
+        if sites == "auto":
+            return "auto"
+        sites = [s.strip() for s in sites.split(",") if s.strip()]
+    names = tuple(sites)
+    for s in names:
+        if s not in SITES:
+            raise ValueError(
+                f"overlap.sites: unknown site {s!r} "
+                f"(valid: {', '.join(SITES)}, or 'auto')")
+    return frozenset(names)
+
+
+def configure(enabled=None, sites=None, issue_distance=None):
+    """Engine wiring: toggle the discipline, pin the overlapped site
+    set ('auto' = autotuned per site), and set the default issue
+    distance (how many collective windows may stay in flight)."""
+    if sites is not None:
+        sites = _normalize_sites(sites)
+    if issue_distance is not None:
+        issue_distance = int(issue_distance)
+        if issue_distance < 1:
+            raise ValueError(
+                "overlap.issue_distance must be >= 1, got "
+                f"{issue_distance}")
+    with _lock:
+        if enabled is not None:
+            _state["enabled"] = bool(enabled)
+        if sites is not None:
+            _state["sites"] = sites
+        if issue_distance is not None:
+            _state["issue_distance"] = issue_distance
+
+
+def reset():
+    """Test hook: restore defaults and drop in-flight accounting."""
+    with _lock:
+        _state["enabled"] = True
+        _state["sites"] = "auto"
+        _state["issue_distance"] = DEFAULT_ISSUE_DISTANCE
+        _state["inflight"] = {}
+
+
+def enabled():
+    return _state["enabled"]
+
+
+def schedule(site, payload_bytes=0, mesh=None):
+    """Resolve the overlap schedule for one site at trace time (pure
+    host-side dict reads — no device sync on this path). Returns
+    ``{"overlap": bool, "issue_distance": int, "granularity": int}``.
+
+    Explicit config wins over the autotune table: a pinned
+    ``overlap.sites`` list means the user decided; only ``"auto"``
+    consults the measured collective-schedule entries."""
+    if site not in SITES:
+        raise ValueError(
+            f"unknown overlap site {site!r} (valid: {', '.join(SITES)})")
+    base = {
+        "overlap": True,
+        "issue_distance": _state["issue_distance"],
+        "granularity": 1,
+    }
+    if not _state["enabled"]:
+        base["overlap"] = False
+        return base
+    sites = _state["sites"]
+    if sites != "auto":
+        base["overlap"] = site in sites
+        return base
+    from deepspeed_tpu.ops import autotune
+    params = autotune.collective_schedule(site, mesh, payload_bytes)
+    if params:
+        for k in ("overlap", "issue_distance", "granularity"):
+            if k in params:
+                base[k] = params[k]
+        base["overlap"] = bool(base["overlap"])
+        base["issue_distance"] = max(int(base["issue_distance"]), 1)
+        base["granularity"] = max(int(base["granularity"]), 1)
+    return base
+
+
+# ----------------------------------------------------------------------
+# in-flight byte accounting (the `overlap_inflight` ledger category)
+# ----------------------------------------------------------------------
+def record_inflight(site, key, nbytes):
+    """Trace-time registration of one site's per-device in-flight
+    staging bytes (MoE dispatch staging, the ring send/recv window,
+    ...). Keyed so re-traces overwrite rather than double-count."""
+    with _lock:
+        _state["inflight"][(str(site), str(key))] = int(nbytes)
+
+
+def inflight_bytes():
+    """Ledger callback: in-flight collective bytes = the sum over
+    sites of the largest single registered window (layers execute one
+    at a time within a site; distinct sites can be in flight
+    together)."""
+    with _lock:
+        items = list(_state["inflight"].items())
+    per_site = {}
+    for (site, _key), nbytes in items:
+        per_site[site] = max(per_site.get(site, 0), int(nbytes))
+    return int(sum(per_site.values()))
+
+
+def reset_inflight():
+    with _lock:
+        _state["inflight"] = {}

@@ -31,8 +31,41 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from deepspeed_tpu.runtime.compat import shard_map
 
+from deepspeed_tpu.ops import overlap as _overlap
 from deepspeed_tpu.ops.transformer.flash_attention import (NEG_INF,
                                                            dense_attention)
+
+
+def _ring_overlap_setup(k, v, axis_name, s_size, overlap_sched=None):
+    """Resolve the `ring` overlap schedule and build the pre-rotated
+    KV window (ops/overlap.py discipline).
+
+    Returns (sched, win): `win` is None when the site is not
+    overlapped (the caller keeps the baseline merge-then-permute
+    scan); otherwise win[j] holds the block j hops back — the block
+    step i+j consumes at step i — so each scan step issues ONE 1-hop
+    `ppermute` of the window's deepest entry BEFORE the held block's
+    merge consumes (`issue_distance` = window depth = permutes in
+    flight; d-1 extra prologue rotations build the stagger). The merge
+    order and block contents are identical to the baseline —
+    scheduled-vs-unscheduled outputs are bit-exact (test-pinned)."""
+    payload = 2 * int(np.prod(k.shape)) * np.dtype(k.dtype).itemsize
+    sched = overlap_sched if overlap_sched is not None else \
+        _overlap.schedule(_overlap.SITE_RING, payload_bytes=payload,
+                          mesh={axis_name: s_size})
+    if not sched["overlap"]:
+        _overlap.record_inflight(_overlap.SITE_RING, axis_name, 0)
+        return sched, None
+    dist = min(max(int(sched["issue_distance"]), 1), s_size)
+    win = [(k, v)]
+    for j in range(1, dist):
+        pj = [(i, (i + j) % s_size) for i in range(s_size)]
+        win.append((jax.lax.ppermute(k, axis_name, pj),
+                    jax.lax.ppermute(v, axis_name, pj)))
+    # the send/recv window: `dist` (K, V) block pairs in flight
+    _overlap.record_inflight(_overlap.SITE_RING, axis_name,
+                             dist * payload)
+    return sched, tuple(win)
 
 
 def _block_attn_partial(q, k, v, sm_scale, mask=None):
@@ -72,7 +105,8 @@ def _merge(acc, num, m_new, l_new):
 
 
 def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
-                      interpret=None, head_packing="auto"):
+                      interpret=None, head_packing="auto",
+                      overlap_sched=None):
     """Per-device ring body on the Pallas flash kernel: each ring step
     folds the held KV block into the running (out, lse) carry via
     `flash_attention_merge` — the softmax-partial merge
@@ -100,10 +134,8 @@ def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
             q, kb, vb, o, lse, causal=step_causal, sm_scale=sm_scale,
             interpret=interpret, head_packing=head_packing)
 
-    def step(carry, step_idx):
-        o, lse, kb, vb = carry
+    def fold(kb, vb, o, lse, step_idx):
         src = (my_idx - step_idx) % s_size
-
         if causal:
             def diag(args):
                 return merged(*args, True)
@@ -116,21 +148,41 @@ def _ring_local_flash(q, k, v, axis_name, causal=True, sm_scale=None,
 
             branch = jnp.where(src == my_idx, 0,
                                jnp.where(src < my_idx, 1, 2))
-            o, lse = jax.lax.switch(branch, [diag, full, none],
-                                    (kb, vb, o, lse))
-        else:
-            o, lse = merged(kb, vb, o, lse, False)
+            return jax.lax.switch(branch, [diag, full, none],
+                                  (kb, vb, o, lse))
+        return merged(kb, vb, o, lse, False)
 
-        kb = jax.lax.ppermute(kb, axis_name, perm)
-        vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (o, lse, kb, vb), None
+    _sched, win = _ring_overlap_setup(k, v, axis_name, s_size,
+                                      overlap_sched)
+    if win is None:
+        def step(carry, step_idx):
+            o, lse, kb, vb = carry
+            o, lse = fold(kb, vb, o, lse, step_idx)
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            return (o, lse, kb, vb), None
 
-    (o, _, _, _), _ = jax.lax.scan(
-        step, (o0, lse0, k, v), jnp.arange(s_size))
+        (o, _, _, _), _ = jax.lax.scan(
+            step, (o0, lse0, k, v), jnp.arange(s_size))
+    else:
+        def step(carry, step_idx):
+            o, lse, blocks = carry
+            kb, vb = blocks[0]
+            nk = jax.lax.ppermute(blocks[-1][0], axis_name, perm)
+            nv = jax.lax.ppermute(blocks[-1][1], axis_name, perm)
+            # issue-early: chunk k+1's permute must be in flight
+            # before chunk k's flash-merge consumes the held block
+            kb, vb = _overlap.fence((kb, vb), (nk, nv))
+            o, lse = fold(kb, vb, o, lse, step_idx)
+            return (o, lse, blocks[1:] + ((nk, nv),)), None
+
+        (o, _, _), _ = jax.lax.scan(
+            step, (o0, lse0, win), jnp.arange(s_size))
     return o.astype(q.dtype)
 
 
-def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
+def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None,
+                         overlap_sched=None):
     """Per-device body (inside shard_map): local Q [B,Tl,H,D] attends to
     the full sequence as KV blocks rotate around `axis_name`."""
     if sm_scale is None:
@@ -145,8 +197,7 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
 
     perm = [(i, (i + 1) % s_size) for i in range(s_size)]
 
-    def step(carry, step_idx):
-        num, m, l, kb, vb = carry
+    def fold(kb, vb, acc, step_idx):
         # kv block currently held originated at device (my_idx - step)
         src = (my_idx - step_idx) % s_size
         if causal:
@@ -164,13 +215,34 @@ def ring_attention_local(q, k, v, axis_name, causal=True, sm_scale=None):
             mask = None
         blk_num, blk_m, blk_l = _block_attn_partial(q, kb, vb, sm_scale,
                                                     mask)
-        num, m, l = _merge((num, m, l), blk_num, blk_m, blk_l)
-        kb = jax.lax.ppermute(kb, axis_name, perm)
-        vb = jax.lax.ppermute(vb, axis_name, perm)
-        return (num, m, l, kb, vb), None
+        return _merge(acc, blk_num, blk_m, blk_l)
 
-    (num, m, l, _, _), _ = jax.lax.scan(
-        step, (num0, m0, l0, k, v), jnp.arange(s_size))
+    _sched, win = _ring_overlap_setup(k, v, axis_name, s_size,
+                                      overlap_sched)
+    if win is None:
+        def step(carry, step_idx):
+            num, m, l, kb, vb = carry
+            num, m, l = fold(kb, vb, (num, m, l), step_idx)
+            kb = jax.lax.ppermute(kb, axis_name, perm)
+            vb = jax.lax.ppermute(vb, axis_name, perm)
+            return (num, m, l, kb, vb), None
+
+        (num, m, l, _, _), _ = jax.lax.scan(
+            step, (num0, m0, l0, k, v), jnp.arange(s_size))
+    else:
+        def step(carry, step_idx):
+            num, m, l, blocks = carry
+            kb, vb = blocks[0]
+            nk = jax.lax.ppermute(blocks[-1][0], axis_name, perm)
+            nv = jax.lax.ppermute(blocks[-1][1], axis_name, perm)
+            # issue-early: the next hop's send is in flight before the
+            # held block's merge consumes
+            kb, vb = _overlap.fence((kb, vb), (nk, nv))
+            num, m, l = fold(kb, vb, (num, m, l), step_idx)
+            return (num, m, l, blocks[1:] + ((nk, nv),)), None
+
+        (num, m, l, _), _ = jax.lax.scan(
+            step, (num0, m0, l0, win), jnp.arange(s_size))
     l = jnp.maximum(l, 1e-30)
     out = num / l.transpose(0, 2, 1, 3)
     return out.astype(q.dtype)

@@ -455,9 +455,20 @@ def get_moe_config(param_dict):
             isinstance(jitter, bool) or jitter < 0:
         raise DeepSpeedConfigError(
             f"moe.jitter_eps must be >= 0, got {jitter!r}")
+    fused = get_scalar_param(block, C.MOE_FUSED_DISPATCH,
+                             C.MOE_FUSED_DISPATCH_DEFAULT)
+    if fused is True:
+        fused = "on"
+    elif fused is False:
+        fused = "off"
+    if fused not in C.MOE_FUSED_DISPATCH_VALID:
+        raise DeepSpeedConfigError(
+            "moe.fused_dispatch must be one of "
+            f"{list(C.MOE_FUSED_DISPATCH_VALID)}, got {fused!r}")
     known = {C.MOE_ENABLED, C.MOE_NUM_EXPERTS, C.MOE_TOP_K,
              C.MOE_CAPACITY_FACTOR, C.MOE_AUX_LOSS_WEIGHT,
-             C.MOE_EVERY_N_LAYERS, C.MOE_JITTER_EPS}
+             C.MOE_EVERY_N_LAYERS, C.MOE_JITTER_EPS,
+             C.MOE_FUSED_DISPATCH}
     unknown = set(block) - known
     if unknown:
         logger.warning(
@@ -466,7 +477,48 @@ def get_moe_config(param_dict):
     return {"enabled": enabled, "num_experts": num_experts,
             "top_k": top_k, "capacity_factor": float(cf),
             "aux_loss_weight": float(aux), "every_n_layers": every,
-            "jitter_eps": float(jitter)}
+            "jitter_eps": float(jitter), "fused_dispatch": fused}
+
+
+def get_overlap_config(param_dict):
+    """Validated `overlap` block -> dict(enabled, sites,
+    issue_distance). Site names are validated against
+    ops/overlap.py's registry so a typo fails at config load, not
+    silently at trace time."""
+    block = param_dict.get(C.OVERLAP, {})
+    if not isinstance(block, dict):
+        raise DeepSpeedConfigError(
+            f'"overlap" must be a dict, got {block!r}')
+    enabled = bool(get_scalar_param(block, C.OVERLAP_ENABLED,
+                                    C.OVERLAP_ENABLED_DEFAULT))
+    sites = block.get(C.OVERLAP_SITES, C.OVERLAP_SITES_DEFAULT)
+    if not (isinstance(sites, str) or
+            (isinstance(sites, (list, tuple)) and
+             all(isinstance(s, str) for s in sites))):
+        raise DeepSpeedConfigError(
+            'overlap.sites must be "auto" or a list of site names, '
+            f"got {sites!r}")
+    from deepspeed_tpu.ops import overlap as _overlap
+    try:
+        _overlap._normalize_sites(sites)
+    except ValueError as e:
+        raise DeepSpeedConfigError(str(e))
+    dist = get_scalar_param(block, C.OVERLAP_ISSUE_DISTANCE,
+                            C.OVERLAP_ISSUE_DISTANCE_DEFAULT)
+    if not isinstance(dist, int) or isinstance(dist, bool) or dist < 1:
+        raise DeepSpeedConfigError(
+            f"overlap.issue_distance must be an int >= 1, got {dist!r}")
+    known = {C.OVERLAP_ENABLED, C.OVERLAP_SITES,
+             C.OVERLAP_ISSUE_DISTANCE}
+    unknown = set(block) - known
+    if unknown:
+        logger.warning(
+            f"overlap: ignoring unknown key(s) {sorted(unknown)}; "
+            f"known keys: {sorted(known)}")
+    return {"enabled": enabled,
+            "sites": list(sites) if not isinstance(sites, str)
+            else sites,
+            "issue_distance": dist}
 
 
 def get_autotune_config(param_dict):
@@ -658,6 +710,7 @@ class DeepSpeedConfig:
 
         self.quantized_compute = get_quantized_compute_config(param_dict)
         self.autotune = get_autotune_config(param_dict)
+        self.overlap = get_overlap_config(param_dict)
         self.moe = get_moe_config(param_dict)
 
         self.pld_enabled = get_pld_enabled(param_dict)

@@ -423,6 +423,11 @@ MESH_EXPERT_AXIS = "expert"
 #   (n_layer must divide evenly); 1 = every block.
 # jitter_eps: multiplicative uniform jitter on router logits during
 #   training (0 = off).
+# fused_dispatch: "on"|"off"|"auto" — swap the one-hot
+#   dispatch/combine einsum pair for the fused gather-scatter kernels
+#   (moe/fused_dispatch.py). "on" refuses expert-parallel meshes (the
+#   einsum pair's sharding constraints ARE the all-to-all there);
+#   "auto" fuses on real TPU without an expert mesh axis.
 #############################################
 MOE = "moe"
 MOE_ENABLED = "enabled"
@@ -439,6 +444,9 @@ MOE_EVERY_N_LAYERS = "every_n_layers"
 MOE_EVERY_N_LAYERS_DEFAULT = 1
 MOE_JITTER_EPS = "jitter_eps"
 MOE_JITTER_EPS_DEFAULT = 0.0
+MOE_FUSED_DISPATCH = "fused_dispatch"
+MOE_FUSED_DISPATCH_DEFAULT = "auto"
+MOE_FUSED_DISPATCH_VALID = ("on", "off", "auto")
 
 #############################################
 # Async dispatch (TPU-native extension): keep N steps in flight.
@@ -568,13 +576,41 @@ QUANTIZED_COMPUTE_STOCHASTIC_ROUNDING_DEFAULT = False
 #   the autotune_flash bench leg or ops.autotune.search; nothing
 #   searches inside a training step).
 # table_path: "" = next to the jax compilation cache
-#   (autotune_table_v1.json), else an explicit JSON path.
+#   (autotune_table_v2.json), else an explicit JSON path.
 #############################################
 AUTOTUNE = "autotune"
 AUTOTUNE_ENABLED = "enabled"
 AUTOTUNE_ENABLED_DEFAULT = True
 AUTOTUNE_TABLE_PATH = "table_path"
 AUTOTUNE_TABLE_PATH_DEFAULT = ""
+
+#############################################
+# Communication/compute overlap runtime (TPU-native extension): the
+# shared optimization_barrier discipline (ops/overlap.py) that phrases
+# issue-early/consume-late schedules at the MoE all-to-all pair, the
+# ring-attention send/recv chain, and ZeRO-3 standalone-leaf gathers.
+# Bit-exact by construction — the barriers constrain the schedule,
+# never the math.
+#   {"overlap": {"enabled": true, "sites": "auto",
+#                "issue_distance": 1}}
+# enabled: master switch for the discipline (off = every site runs
+#   its unscheduled baseline).
+# sites: "auto" (default) consults the autotune collective-schedule
+#   table per (site, mesh shape, payload bucket); or an explicit list
+#   drawn from ["moe_dispatch", "ring", "zero3_leaf"] to pin exactly
+#   which sites overlap.
+# issue_distance: how many collective windows may stay in flight at
+#   the ring site (>= 1); also the default the autotuner's candidates
+#   are measured against. In-flight staging bytes are ledgered as the
+#   `overlap_inflight` category (docs/monitoring.md).
+#############################################
+OVERLAP = "overlap"
+OVERLAP_ENABLED = "enabled"
+OVERLAP_ENABLED_DEFAULT = True
+OVERLAP_SITES = "sites"
+OVERLAP_SITES_DEFAULT = "auto"
+OVERLAP_ISSUE_DISTANCE = "issue_distance"
+OVERLAP_ISSUE_DISTANCE_DEFAULT = 1
 
 #############################################
 # Inference/serving engine (TPU-native extension): AOT-compiled

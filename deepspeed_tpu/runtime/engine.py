@@ -285,6 +285,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             self._config.async_dispatch_steps_per_sync or \
             self.steps_per_print()
         self._init_autotune()
+        self._init_overlap()
         self._init_quantized_compute()
         self._init_moe()
         self._configure_optimizer()
@@ -973,6 +974,26 @@ class DeepSpeedEngine(ZeroOffloadMixin):
             table_path=at["table_path"],
             monitor=self.monitor if self.monitor.enabled else False)
 
+    def _init_overlap(self):
+        """Wire the `overlap` config block into the shared
+        communication/compute overlap runtime (ops/overlap.py):
+        enabled toggle, pinned-vs-autotuned site set, and the default
+        issue distance. Emits one `overlap` monitor event recording
+        the configuration. Schedule resolution afterwards is a pure
+        host-side dict read at trace time — no device sync."""
+        from deepspeed_tpu.ops import overlap
+        ov = self._config.overlap
+        overlap.configure(
+            enabled=ov["enabled"],
+            sites=ov["sites"],
+            issue_distance=ov["issue_distance"])
+        if self.monitor.enabled:
+            self.monitor.event(
+                "overlap", enabled=ov["enabled"],
+                sites=(ov["sites"] if isinstance(ov["sites"], str)
+                       else ",".join(sorted(ov["sites"]))),
+                issue_distance=ov["issue_distance"])
+
     def _init_quantized_compute(self):
         """Wire the `quantized_compute` config block into the model:
         call its `configure_quantized_compute` hook (GPT-2 family)
@@ -1041,7 +1062,8 @@ class DeepSpeedEngine(ZeroOffloadMixin):
              top_k=mc["top_k"],
              capacity_factor=mc["capacity_factor"],
              aux_loss_weight=mc["aux_loss_weight"],
-             jitter_eps=mc["jitter_eps"])
+             jitter_eps=mc["jitter_eps"],
+             fused_dispatch=mc["fused_dispatch"])
         self._moe_active = True
         # router stats ride the jitted step only when something drains
         # them (the monitor fence) — dense-engine traces stay identical
@@ -1054,6 +1076,7 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 aux_loss_weight=mc["aux_loss_weight"],
                 every_n_layers=mc["every_n_layers"],
                 jitter_eps=mc["jitter_eps"],
+                fused_dispatch=mc["fused_dispatch"],
                 expert_axis=es)
         log_dist(
             f"MoE: {mc['num_experts']} experts (top_k={mc['top_k']}, "
@@ -1174,6 +1197,16 @@ class DeepSpeedEngine(ZeroOffloadMixin):
                 lambda: dispatch_bytes_per_layer(
                     mesh, num_experts=n_experts,
                     width=width) * n_moe_layers)
+        # comm/compute overlap in-flight staging (MoE dispatch window,
+        # ring send/recv rotations): per-device bytes registered by
+        # the sites at trace time (ops/overlap.py record_inflight) —
+        # DYNAMIC like zero3_gather: 0 until the first step traces and
+        # 0 whenever every site resolves to overlap-off; OOM forensics
+        # can then name overlap.issue_distance as the knob
+        from deepspeed_tpu.ops import overlap as _overlap
+        led.register_dynamic(
+            _mem.CAT_OVERLAP, "overlap.inflight_window",
+            _overlap.inflight_bytes)
 
     def _count_model_params(self, tree):
         """Model parameter count for logs/profiling; engines whose

@@ -251,8 +251,9 @@ class PipelineEngine(DeepSpeedEngine):
                 stats = [] if collect else None
                 # ZeRO-3 runtime on the unrolled chain: each layer's
                 # sharded params all-gather through the scheduler, with
-                # an optimization_barrier tying layer idx's gather to
-                # the activation entering layer idx - prefetch_layers —
+                # the shared overlap fence (ops/overlap.py) tying layer
+                # idx's gather to the activation entering layer
+                # idx - prefetch_layers —
                 # without the fence XLA may hoist every gather to the
                 # top of the program (the naive up-front pattern);
                 # backward reduce-scatters each layer's grad into its

@@ -37,9 +37,11 @@ bound on live full-param bytes.  This module is the explicit runtime:
 `nn.scan` layout of the GPT-2/BERT layer stacks) through a custom-VJP
 scan implementing exactly that schedule; `gather` handles standalone
 leaves (embeddings, heads) and, with `depend=`, the unrolled
-PipelineModule layer chain, where `jax.lax.optimization_barrier` ties
-layer k's gather to the activation entering layer k-prefetch so XLA
-cannot hoist every gather to the top of the program.
+PipelineModule layer chain, where the shared overlap fence
+(`deepspeed_tpu.ops.overlap.fence`, the optimization_barrier
+discipline's one home) ties layer k's gather to the activation
+entering layer k-prefetch so XLA cannot hoist every gather to the top
+of the program.
 
 `release_after_use=False` is the naive stage-3 baseline the bench leg
 `zero3_overlap` A/Bs against: the whole stack is gathered up front,
@@ -67,6 +69,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
+from deepspeed_tpu.ops.overlap import fence as _fence
 from deepspeed_tpu.runtime.mesh import DATA_AXIS
 from deepspeed_tpu.runtime.zero.partition import leaf_data_spec
 
@@ -95,7 +98,10 @@ def _zeros_ct(x):
     if x is None:
         return None
     dtype = np.result_type(getattr(x, "dtype", np.float32))
-    if np.issubdtype(dtype, np.inexact):
+    # jax.dtypes, not np: bfloat16 is an ml_dtypes extension type that
+    # numpy's issubdtype does NOT class as inexact — a bf16 activation
+    # must get bf16 zeros, never float0
+    if jax.dtypes.issubdtype(dtype, np.inexact):
         return jnp.zeros(np.shape(x), dtype)
     return np.zeros(np.shape(x), jax.dtypes.float0)
 
@@ -106,9 +112,10 @@ def _gathered_leaf(ctx, x, dep):
 
     fwd: optional cast to the gather dtype, then a sharding constraint
     to the data-replicated spec — GSPMD lowers it to the all-gather.
-    With `dep` the leaf is fused through an optimization_barrier with
-    the given activation first, so the gather cannot be scheduled
-    before `dep` exists (the unrolled-chain prefetch fence).
+    With `dep` the leaf runs through the shared overlap fence
+    (ops/overlap.py, the one home of the optimization_barrier
+    discipline) first, so the gather cannot be scheduled before `dep`
+    exists (the unrolled-chain prefetch fence).
 
     bwd: the cotangent is constrained straight to the OWNING data-axis
     shard — GSPMD lowers the (sum-over-shards cotangent -> sharded)
@@ -119,7 +126,7 @@ def _gathered_leaf(ctx, x, dep):
     full_s, shard_s, gdt, xdt, dep_meta = ctx
     y = x if gdt is None else x.astype(gdt)
     if dep is not None:
-        y, _ = jax.lax.optimization_barrier((y, dep))
+        y = _fence(y, dep)
     return jax.lax.with_sharding_constraint(y, full_s)
 
 
@@ -135,7 +142,10 @@ def _gathered_leaf_bwd(ctx, _res, ct):
     if dep_meta is None:
         return g, None
     shape, dtype = dep_meta
-    if np.issubdtype(dtype, np.inexact):
+    # jax.dtypes: numpy's issubdtype misclassifies bfloat16 as
+    # non-inexact, which would hand a bf16 dep a float0 cotangent and
+    # break the add with the dep's real gradient path
+    if jax.dtypes.issubdtype(dtype, np.inexact):
         return g, jnp.zeros(shape, dtype)
     return g, np.zeros(shape, jax.dtypes.float0)
 
