@@ -2644,6 +2644,132 @@ def bench_serving_observability():
     return out
 
 
+def bench_speculative_decode():
+    """Speculative-decoding serving A/B (ISSUE 18): the Poisson-arrival
+    continuous-batching harness run twice on the SAME engine config —
+    vanilla decode vs draft-propose/flagship-verify — in paired
+    order-alternating trials, all requests at temperature 0.
+
+    The losslessness contract is HARD-asserted in-leg: every request's
+    token stream from the speculative engine must be BIT-IDENTICAL to
+    the vanilla engine's (greedy acceptance is exact prefix match, so
+    at temp 0 speculation may only change wall time, never one token).
+
+    The model is built so the draft is good but not perfect: an
+    8-layer flagship whose blocks 1..7 have their residual projections
+    (`c_proj` / `mlp_c_proj`) damped to 0.7x, making the truncate:1
+    draft (block 0 + the shared embeddings/ln_f) agree with the
+    flagship on most steps — acceptance lands ~0.99 with real
+    rejected-suffix rollbacks, so the rollback path is exercised by
+    the timed runs, not just the tests. Deterministic: no runtime RNG
+    touches the draft, so acceptance numbers repeat exactly."""
+    from deepspeed_tpu.inference import (InferenceEngine, Request,
+                                         ServingLoop)
+    from deepspeed_tpu.models.gpt2 import (GPT2ForCausalLM,
+                                           tiny_gpt2_config)
+
+    cfg = tiny_gpt2_config(n_layer=8, n_embd=128, n_positions=256)
+    model = GPT2ForCausalLM(cfg)
+    r = np.random.RandomState(0)
+    params = model.init(jax.random.PRNGKey(0),
+                        {"input_ids": np.zeros((1, 8), np.int32)})
+    # damp blocks 1..7 (stacked layer dim): flagship stays close to
+    # its own first block = the draft, without being equal to it
+    blocks = dict(params["h"]["GPT2Block_0"])
+    for name in ("c_proj", "mlp_c_proj"):
+        leaf = dict(blocks[name])
+        for key in ("kernel", "bias"):
+            arr = np.asarray(leaf[key]).copy()
+            arr[1:] *= 0.7
+            leaf[key] = arr
+        blocks[name] = leaf
+    params = dict(params)
+    params["h"] = {"GPT2Block_0": blocks}
+
+    inf_cfg = {"max_slots": 8, "prefill_chunk": 32, "sync_every": 4,
+               "max_new_tokens": 128,
+               "kv_cache": {"num_pages": 320, "page_size": 8}}
+    spec_cfg = dict(inf_cfg, speculative={
+        "enabled": True, "draft_model": "truncate:1",
+        "k": 4, "k_min": 1, "adaptive": True})
+    eng_van = InferenceEngine(cfg, params, {"inference": dict(inf_cfg)})
+    eng_spec = InferenceEngine(cfg, params,
+                               {"inference": dict(spec_cfg)})
+
+    # decode-heavy Poisson stream: short prompts, long generations,
+    # arrivals fast enough to keep all 8 slots saturated
+    n_req = 24
+    gaps = r.exponential(scale=0.004, size=n_req)
+    arrivals = np.cumsum(gaps)
+    lens = r.randint(4, 18, size=n_req)
+    news = r.randint(64, 113, size=n_req)
+    prompts = [r.randint(0, cfg.vocab_size, size=int(l)).astype(np.int32)
+               for l in lens]
+
+    def make_requests():
+        return [Request(rid=i, tokens=prompts[i].copy(),
+                        max_new_tokens=int(news[i]),
+                        arrival_time=float(arrivals[i]))
+                for i in range(n_req)]
+
+    for eng in (eng_van, eng_spec):
+        ServingLoop(eng).serve([Request(
+            rid="w", tokens=prompts[0].copy(), max_new_tokens=4)])
+        eng.reset()
+
+    totals = {"van": [0, 0.0], "spec": [0, 0.0]}
+    outs = {}
+    spec_counters = None
+    trials = 2
+    for trial in range(trials):
+        order = [("van", eng_van), ("spec", eng_spec)]
+        if trial % 2:
+            order.reverse()
+        for tag, eng in order:
+            loop = ServingLoop(eng)
+            loop.serve(make_requests())
+            wall = max(q.finished_at for q in loop.results)
+            totals[tag][0] += sum(
+                len(q.out_tokens) for q in loop.results)
+            totals[tag][1] += wall
+            outs[tag] = {q.rid: np.asarray(q.out_tokens)
+                         for q in loop.results}
+            if tag == "spec":
+                sp = eng.fetch_state()["speculative"]
+                spec_counters = (int(sp["drafted"].sum()),
+                                 int(sp["accepted"].sum()),
+                                 int(sp["verified"].sum()),
+                                 int(sp["rollbacks"].sum()))
+            eng.reset()
+        # the losslessness contract, checked every trial
+        assert all(np.array_equal(outs["van"][i], outs["spec"][i])
+                   for i in range(n_req)), \
+            "speculative decode diverged bitwise from vanilla at temp 0"
+
+    d, a, v, rb = spec_counters
+    van_tps = totals["van"][0] / totals["van"][1]
+    spec_tps = totals["spec"][0] / totals["spec"][1]
+    speedup = spec_tps / van_tps
+    n_chips = max(len(jax.devices()), 1)
+    return {
+        "model": "gpt2-tiny-8l-128d (blocks 1..7 damped 0.7x)",
+        "draft_model": "truncate:1", "k": 4, "adaptive": True,
+        "requests": n_req, "trials": trials,
+        "poisson_mean_interarrival_ms": 4.0,
+        "temp0_bitexact": True,            # hard-asserted above
+        "acceptance_rate": round(a / d, 4),
+        "tokens_per_verify": round((a + v) / v, 3),
+        "drafted_tokens": d, "accepted_tokens": a,
+        "rollback_events": rb,
+        "vanilla_tokens_per_sec": round(van_tps, 1),
+        "speculative_tokens_per_sec": round(spec_tps, 1),
+        "speculative_speedup": round(speedup, 2),
+        "tokens_per_sec_per_chip": round(spec_tps / n_chips, 1),
+        "target_1_5x_met": bool(speedup >= 1.5),
+        "devices": n_chips,
+    }
+
+
 # Named bench legs (single source for both `--only` and the full-suite
 # extras; each returns one JSON-able dict). Order matters: the full
 # suite runs the TPU legs in this order, then the memory plan.
@@ -3433,6 +3559,7 @@ BENCH_LEGS = {
     "elastic_recovery": bench_elastic_recovery,
     "serving_throughput": bench_serving_throughput,
     "serving_observability": bench_serving_observability,
+    "speculative_decode": bench_speculative_decode,
     "quantized_matmul": bench_quantized_matmul,
     "autotune_flash": bench_autotune_flash,
     "moe_vs_dense": bench_moe_vs_dense,

@@ -84,6 +84,15 @@ HOT_ENTRYPOINTS = (
     "deepspeed_tpu.moe.fused_dispatch:routing_slots",
     "deepspeed_tpu.moe.fused_dispatch:fused_dispatch",
     "deepspeed_tpu.moe.fused_dispatch:fused_combine",
+    # speculative decoding (PR 18): the three AOT step builders (their
+    # inner functions are the compiled draft-decode / verify /
+    # draft-prefill programs — acceptance, rollback, and adaptive-k
+    # all happen INSIDE verify) and the engine's round dispatcher;
+    # rounds chain device-side, so none of these may sync
+    "deepspeed_tpu.inference.speculative:build_draft_step",
+    "deepspeed_tpu.inference.speculative:build_verify_step",
+    "deepspeed_tpu.inference.speculative:build_draft_prefill_step",
+    "deepspeed_tpu.inference.engine:InferenceEngine.spec_block",
 )
 
 # ----------------------------------------------------------------------

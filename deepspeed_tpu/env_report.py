@@ -218,6 +218,16 @@ def feature_report():
     except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
         rows.append(("serving observability", f"{FAIL} {e}"))
     try:
+        from deepspeed_tpu.inference.speculative import build_verify_step  # noqa: F401,E501
+        rows.append((
+            "speculative decoding",
+            f"{SUCCESS} draft propose + batched verify, lossless "
+            "acceptance sampling, paged-KV rollback, adaptive k "
+            "(inference.speculative; bench.py --only "
+            "speculative_decode; docs/inference.md)"))
+    except Exception as e:  # ds-lint: allow[BROADEXC] environment probe: the failure text IS the report row
+        rows.append(("speculative decoding", f"{FAIL} {e}"))
+    try:
         from deepspeed_tpu.moe import MoEMLP  # noqa: F401
         rows.append((
             "mixture of experts",

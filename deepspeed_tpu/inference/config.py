@@ -13,7 +13,12 @@
                    "observability": {"enabled": true,
                                      "slo_ttft_ms": 0,
                                      "slo_token_ms": 0},
-                   "kv_cache": {"num_pages": 256, "page_size": 16}}}
+                   "kv_cache": {"num_pages": 256, "page_size": 16},
+                   "speculative": {"enabled": false,
+                                   "draft_model": "truncate:1",
+                                   "k": 4,
+                                   "k_min": 1,
+                                   "adaptive": true}}}
 
 See the key-by-key commentary in runtime/constants.py (the
 "Inference/serving engine" section) and docs/inference.md. Validation
@@ -137,3 +142,43 @@ class InferenceConfig:
         self.kv_page_size = _pos_int(
             kv, C.INFERENCE_KV_PAGE_SIZE, C.INFERENCE_KV_PAGE_SIZE_DEFAULT,
             "inference.kv_cache.page_size")
+
+        spec = block.get(C.INFERENCE_SPECULATIVE, {})
+        if not isinstance(spec, dict):
+            raise InferenceConfigError(
+                f'"inference.speculative" must be a dict, got {spec!r}')
+        self.spec_enabled = bool(get_scalar_param(
+            spec, C.INFERENCE_SPEC_ENABLED,
+            C.INFERENCE_SPEC_ENABLED_DEFAULT))
+        self.spec_draft_model = get_scalar_param(
+            spec, C.INFERENCE_SPEC_DRAFT_MODEL,
+            C.INFERENCE_SPEC_DRAFT_MODEL_DEFAULT)
+        if not isinstance(self.spec_draft_model, str) or not (
+                self.spec_draft_model == "external" or
+                self.spec_draft_model.startswith("truncate:")):
+            raise InferenceConfigError(
+                'inference.speculative.draft_model must be "truncate:N" '
+                f'or "external", got {self.spec_draft_model!r}')
+        if self.spec_draft_model.startswith("truncate:"):
+            tail = self.spec_draft_model[len("truncate:"):]
+            try:
+                n = int(tail)
+            except ValueError:
+                n = 0
+            if n < 1:
+                raise InferenceConfigError(
+                    "inference.speculative.draft_model truncate layer "
+                    f"count must be a positive integer, got {tail!r}")
+        self.spec_k = _pos_int(
+            spec, C.INFERENCE_SPEC_K, C.INFERENCE_SPEC_K_DEFAULT,
+            "inference.speculative.k")
+        self.spec_k_min = _pos_int(
+            spec, C.INFERENCE_SPEC_K_MIN, C.INFERENCE_SPEC_K_MIN_DEFAULT,
+            "inference.speculative.k_min")
+        if self.spec_k_min > self.spec_k:
+            raise InferenceConfigError(
+                f"inference.speculative.k_min ({self.spec_k_min}) must "
+                f"be <= inference.speculative.k ({self.spec_k})")
+        self.spec_adaptive = bool(get_scalar_param(
+            spec, C.INFERENCE_SPEC_ADAPTIVE,
+            C.INFERENCE_SPEC_ADAPTIVE_DEFAULT))

@@ -31,6 +31,8 @@ application below switches onto the dequant-in-matmul epilogue;
 everything else (cache, scheduler, sampling) is unchanged.
 """
 
+import time
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
@@ -43,6 +45,37 @@ from deepspeed_tpu.inference.quant import (KERNEL_SCALE, int8_matmul,
 from deepspeed_tpu.monitor import DeepSpeedMonitorConfig, Monitor
 from deepspeed_tpu.monitor import memory as memory_mod
 from deepspeed_tpu.utils.logging import logger
+
+
+def compile_fresh(lowered):
+    """Compile a lowered program with the persistent compilation cache
+    bypassed. On XLA:CPU an executable deserialized from the cache is
+    re-codegenned at load and its float reductions can land a few ulps
+    away from a fresh compile of the SAME HLO. The serving programs
+    carry cross-program bit-equality contracts (decode == training
+    forward; speculative verify == decode, which is what makes
+    speculative decoding lossless at temp 0) — those only hold when
+    every program in the set comes from the same codegen path, so none
+    of them may be resurrected from a cache written by another
+    process."""
+    try:
+        from jax._src.compilation_cache import reset_cache
+    except ImportError:  # ds-lint: allow[BROADEXC] jax-internal probe
+        reset_cache = None
+    if not jax.config.jax_enable_compilation_cache or reset_cache is None:
+        return lowered.compile()
+    # is_cache_used() memoizes its verdict process-wide at the first
+    # compile, so flipping the flag alone is not enough: reset_cache()
+    # drops the memo (and the in-memory LRU) so the disabled flag is
+    # actually consulted, then again afterwards so later compiles
+    # re-initialize the cache normally
+    jax.config.update("jax_enable_compilation_cache", False)
+    reset_cache()
+    try:
+        return lowered.compile()
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+        reset_cache()
 
 
 # ----------------------------------------------------------------------
@@ -154,7 +187,8 @@ class InferenceEngine:
     rendezvous (the serving fence — declared in the ds_lint registry
     and pinned by the dynamic guard test)."""
 
-    def __init__(self, model_config, params, config=None, rank=0):
+    def __init__(self, model_config, params, config=None, rank=0,
+                 draft_params=None, draft_model_config=None):
         self.model_config = model_config
         cfg = InferenceConfig(config or {})
         self.config = cfg
@@ -200,6 +234,56 @@ class InferenceEngine:
         self._prefill = self._build_prefill_step()
         self._last_logits = None
 
+        # speculative decoding (ISSUE 18, inference/speculative.py):
+        # gated on the config default-off, so the disabled engine's
+        # compiled programs and state are byte-for-byte the above
+        self.speculative_enabled = cfg.spec_enabled
+        self._draft_decode = self._verify = self._draft_prefill = None
+        if cfg.spec_enabled:
+            from deepspeed_tpu.inference import speculative as spec_mod
+            if cfg.spec_draft_model == "external":
+                if draft_params is None or draft_model_config is None:
+                    raise ValueError(
+                        'inference.speculative.draft_model="external" '
+                        "requires draft_params and draft_model_config")
+                if cfg.weight_bits == 8:
+                    draft_params = quantize_param_tree(
+                        draft_params, cfg.weight_quant_block)
+                self._draft_config = draft_model_config
+                self._draft_params = draft_params
+            else:
+                self._draft_config, self._draft_params = \
+                    spec_mod.derive_draft(model_config, params,
+                                          cfg.spec_draft_model)
+            if self._draft_config.n_head != model_config.n_head or \
+                    self._draft_config.head_dim != model_config.head_dim:
+                raise ValueError(
+                    "speculative draft model must share the flagship's "
+                    "head geometry (the draft KV pool reuses the "
+                    "flagship page-table shapes)")
+            self.cache.attach_draft(self._draft_config.n_layer)
+            # only the draft's own block stack is new device bytes —
+            # wte/wpe/ln_f are shared references with the flagship
+            self.monitor.ledger.register_tree(
+                memory_mod.CAT_PARAMS, "inference.draft_params",
+                self._draft_params["h"])
+            self._spec_state = spec_mod.fresh_spec_state(self)
+            self._draft_decode = spec_mod.build_draft_step(self)
+            self._verify = spec_mod.build_verify_step(self)
+            self._draft_prefill = spec_mod.build_draft_prefill_step(self)
+            # host mirror of the draft dispatch depth: max(live k_slot)
+            # as of the last fence (adaptive back-off without any extra
+            # host<->device sync)
+            self._spec_next_draft = cfg.spec_k
+            self._spec_draft_dispatch_s = 0.0
+            self._spec_verify_dispatch_s = 0.0
+            logger.info(
+                "inference: speculative decoding enabled "
+                f"(draft={cfg.spec_draft_model}, "
+                f"{self._draft_config.n_layer}/{model_config.n_layer} "
+                f"layers, k={cfg.spec_k}, "
+                f"adaptive={cfg.spec_adaptive})")
+
     # ------------------------------------------------------------------
     # state
     # ------------------------------------------------------------------
@@ -232,6 +316,12 @@ class InferenceEngine:
             self.cache.free(slot)
         self._state = self._fresh_state()
         self._tables_version = self.cache.table_version
+        if self.speculative_enabled:
+            from deepspeed_tpu.inference import speculative as spec_mod
+            self._spec_state = spec_mod.fresh_spec_state(self)
+            self._spec_next_draft = self.config.spec_k
+            self._spec_draft_dispatch_s = 0.0
+            self._spec_verify_dispatch_s = 0.0
         if self.tracker is not None:
             self.tracker.on_reset()
 
@@ -315,8 +405,8 @@ class InferenceEngine:
             )
             return new_state, logits
 
-        return jax.jit(decode_fn, donate_argnums=(1,)).lower(
-            self._params, self._state).compile()
+        return compile_fresh(jax.jit(decode_fn, donate_argnums=(1,))
+                             .lower(self._params, self._state))
 
     def _build_prefill_step(self):
         cfg, mc = self.config, self.model_config
@@ -354,8 +444,8 @@ class InferenceEngine:
                 jnp.asarray(self.cache.tables[0]),
                 jnp.zeros((chunk,), jnp.int32),
                 jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
-        return jax.jit(prefill_fn, donate_argnums=(1, 2)).lower(
-            *args).compile()
+        return compile_fresh(jax.jit(prefill_fn, donate_argnums=(1, 2))
+                             .lower(*args))
 
     # ------------------------------------------------------------------
     # fence-side slot management (host work, runs between blocks)
@@ -380,6 +470,16 @@ class InferenceEngine:
             jnp.asarray(self.cache.tables[slot]), jnp.asarray(buf),
             jnp.asarray(start, jnp.int32), jnp.asarray(n, jnp.int32))
         st["k_pool"], st["v_pool"] = k, v
+        if self.speculative_enabled:
+            # the draft attends over the whole committed prefix, so
+            # its pool must cache the prompt too (same chunk, same
+            # page-table row, draft layer count)
+            sp = self._spec_state
+            dk, dv = self._draft_prefill(
+                self._draft_params, sp["dk_pool"], sp["dv_pool"],
+                jnp.asarray(self.cache.tables[slot]), jnp.asarray(buf),
+                jnp.asarray(start, jnp.int32), jnp.asarray(n, jnp.int32))
+            sp["dk_pool"], sp["dv_pool"] = dk, dv
         self._host_steps += 1
 
     def activate_slot(self, slot, cur_token, pos, max_new, temperature,
@@ -397,6 +497,12 @@ class InferenceEngine:
         st["top_k"] = st["top_k"].at[slot].set(int(top_k))
         st["eos"] = st["eos"].at[slot].set(
             -1 if eos is None else int(eos))
+        if self.speculative_enabled:
+            # new request, fresh speculation posture: optimistic k,
+            # clean acceptance EMA
+            sp = self._spec_state
+            sp["k_slot"] = sp["k_slot"].at[slot].set(self.config.spec_k)
+            sp["acc_ema"] = sp["acc_ema"].at[slot].set(1.0)
 
     def start_request(self, slot, prompt, max_new, temperature=0.0,
                       top_k=0, eos=None):
@@ -464,13 +570,70 @@ class InferenceEngine:
         self._host_steps += 1
         return logits
 
+    def spec_block(self, rounds):
+        """Dispatch `rounds` speculative rounds back-to-back — each
+        round is `spec_next_draft()` draft-decode dispatches plus ONE
+        flagship verify, acceptance decided device-side — with zero
+        host syncs (the same HOTSYNC contract as decode_block; the
+        guard tests run this loop under the sync counters). The
+        per-phase perf_counter spans are DISPATCH time (execution is
+        async and settles at the fence) — the drafted-vs-verified
+        split the tracker reports."""
+        st, sp = self._state, self._spec_state
+        nd = self._spec_next_draft
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            for _j in range(nd):
+                sp = self._draft_decode(self._draft_params, st, sp)
+            t1 = time.perf_counter()
+            st, sp = self._verify(self._params, st, sp)
+            self._spec_draft_dispatch_s += t1 - t0
+            self._spec_verify_dispatch_s += time.perf_counter() - t1
+        self._state, self._spec_state = st, sp
+        self._host_steps += rounds * (nd + 1)
+
+    def spec_next_draft(self):
+        """Draft steps the next spec_block will dispatch per round
+        (max live k_slot as of the last fence; the worst-case tokens
+        per round for capacity planning is this + 1)."""
+        return self._spec_next_draft
+
+    def spec_dispatch_split(self):
+        """Drain the accumulated (draft_s, verify_s) dispatch spans
+        (host perf_counter, reset on read — one reader per fence)."""
+        split = (self._spec_draft_dispatch_s,
+                 self._spec_verify_dispatch_s)
+        self._spec_draft_dispatch_s = 0.0
+        self._spec_verify_dispatch_s = 0.0
+        return split
+
     def fetch_state(self):
         """THE serving fence: one fused device_get of the per-slot
         progress the scheduler needs (active flags, eos flags,
-        positions, generated counts, output rings)."""
+        positions, generated counts, output rings — plus, when
+        speculation is on, the round counters, still inside the SAME
+        fused get)."""
         st = self._state
-        active, eos, pos, n_gen, out = jax.device_get(
-            (st["active"], st["finished_eos"], st["pos"], st["n_gen"],
-             st["out_tokens"]))
+        targets = (st["active"], st["finished_eos"], st["pos"],
+                   st["n_gen"], st["out_tokens"])
+        if not self.speculative_enabled:
+            active, eos, pos, n_gen, out = jax.device_get(targets)
+            return {"active": active, "finished_eos": eos, "pos": pos,
+                    "n_gen": n_gen, "out_tokens": out}
+        sp = self._spec_state
+        (active, eos, pos, n_gen, out, k_slot, drafted, accepted,
+         verified, rollbacks, rounds) = jax.device_get(
+            targets + (sp["k_slot"], sp["drafted_total"],
+                       sp["accepted_total"], sp["verified_total"],
+                       sp["rollbacks"], sp["rounds"]))
+        if self.config.spec_adaptive:
+            live = k_slot[active] if active.any() else None
+            self._spec_next_draft = int(live.max()) \
+                if live is not None else self.config.spec_k
         return {"active": active, "finished_eos": eos, "pos": pos,
-                "n_gen": n_gen, "out_tokens": out}
+                "n_gen": n_gen, "out_tokens": out,
+                "speculative": {"k_slot": k_slot, "drafted": drafted,
+                                "accepted": accepted,
+                                "verified": verified,
+                                "rollbacks": rollbacks,
+                                "rounds": int(rounds)}}

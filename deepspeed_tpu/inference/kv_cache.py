@@ -83,12 +83,39 @@ class PagedKVCache:
         self.table_version = 0
         self._ledger = ledger
         self._ledger_tokens = {}
+        # speculative-decoding draft pool (attach_draft): same page
+        # tables/allocator, fewer layers, its own ledger category
+        self.draft_n_layer = 0
+        self.draft_page_bytes = 0
+        self.draft_pool_bytes = 0
+        self._draft_ledger_tokens = {}
         if ledger is not None:
             ledger.register_dynamic(
                 memory_mod.CAT_KV, "pool.unallocated",
                 lambda: self.pool_bytes - self.allocated_bytes(),
                 meta={"num_pages": self.num_pages,
                       "page_size": self.page_size})
+
+    def attach_draft(self, n_layer_draft):
+        """Declare the speculative draft model's KV pool: it shares
+        this cache's page tables and free-list verbatim (one allocator,
+        one admission decision), so the only new accounting is bytes —
+        a second ledger category (`kv_cache_draft`) with the same
+        unallocated + per-request split, phrased in draft page bytes
+        (the flagship's page bytes scaled to the draft's layer count)."""
+        self.draft_n_layer = int(n_layer_draft)
+        self.draft_page_bytes = (2 * self.draft_n_layer * self.page_size *
+                                 self.n_head * self.head_dim *
+                                 self.dtype.itemsize)
+        self.draft_pool_bytes = self.num_pages * self.draft_page_bytes
+        if self._ledger is not None:
+            self._ledger.register_dynamic(
+                memory_mod.CAT_KV_DRAFT, "pool.unallocated",
+                lambda: self.draft_pool_bytes -
+                self.pages_in_use() * self.draft_page_bytes,
+                meta={"num_pages": self.num_pages,
+                      "page_size": self.page_size,
+                      "n_layer_draft": self.draft_n_layer})
 
     # -- accounting -----------------------------------------------------
     def pages_for_tokens(self, n_tokens):
@@ -172,6 +199,15 @@ class PagedKVCache:
                 (lambda s: lambda: self.slot_bytes(s))(slot),
                 meta={"slot": int(slot),
                       "request": self._names[slot]})
+            if self.draft_n_layer:
+                self._draft_ledger_tokens[slot] = \
+                    self._ledger.register_dynamic(
+                        memory_mod.CAT_KV_DRAFT,
+                        f"request.s{slot}.{self._names[slot]}",
+                        (lambda s: lambda: self.allocated_pages(s) *
+                         self.draft_page_bytes)(slot),
+                        meta={"slot": int(slot),
+                              "request": self._names[slot]})
 
     def ensure(self, slot, n_tokens):
         """Assign pages so `slot` can hold positions [0, n_tokens).
@@ -192,6 +228,32 @@ class PagedKVCache:
             self.table_version += 1
         return pages
 
+    def rollback(self, slot, n_tokens):
+        """Rewind `slot` to exactly the pages needed for positions
+        [0, n_tokens) — the rejected-suffix rollback of speculative
+        decoding. NO page data is copied or cleared: the device-side
+        kv_limit (the slot's `pos`) is what masks stale K/V, so
+        rollback is pure host accounting — trimmed pages go back on
+        the LIFO free list (a re-advance pops the SAME physical pages
+        into the SAME table columns) and the freed table columns reset
+        to the scratch page. Returns the number of pages released; a
+        rollback that trims nothing is a no-op (no table_version bump,
+        no table upload)."""
+        if slot not in self._pages:
+            raise ValueError(f"slot {slot} is not admitted")
+        need = self.pages_for_tokens(n_tokens)
+        pages = self._pages[slot]
+        if need >= len(pages):
+            return 0
+        freed = pages[need:]
+        del pages[need:]
+        # reversed: the highest-position page ends up on top of the
+        # LIFO list, so regrowth reassigns page-for-page identically
+        self._free.extend(reversed(freed))
+        self.tables[slot, need:need + len(freed)] = 0
+        self.table_version += 1
+        return len(freed)
+
     def free(self, slot):
         """Return `slot`'s pages to the free list, drop its
         reservation, close its ledger entry, and reset its table row to
@@ -205,4 +267,7 @@ class PagedKVCache:
         token = self._ledger_tokens.pop(slot, None)
         if token is not None and self._ledger is not None:
             self._ledger.release(token)
+        dtoken = self._draft_ledger_tokens.pop(slot, None)
+        if dtoken is not None and self._ledger is not None:
+            self._ledger.release(dtoken)
         return len(pages)

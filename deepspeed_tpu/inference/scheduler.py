@@ -75,6 +75,16 @@ class ServingLoop:
         # host dispatch stamp of the current decode block (the serving
         # tracker's per-fence decode window; None = no block in flight)
         self._decode_t0 = None
+        # speculative-decoding fence mirrors: the device counters are
+        # cumulative per slot (never reset mid-flight), so the fence
+        # diffs them against these to get per-window numbers
+        self._spec = bool(getattr(engine, "speculative_enabled", False))
+        s = engine.config.max_slots
+        self._last_drafted = np.zeros((s,), np.int64)
+        self._last_accepted = np.zeros((s,), np.int64)
+        self._last_verified = np.zeros((s,), np.int64)
+        self._last_rollbacks = np.zeros((s,), np.int64)
+        self._last_rounds = 0
 
     # -- submission -----------------------------------------------------
     def submit(self, req):
@@ -162,13 +172,22 @@ class ServingLoop:
         if not self.live and not self.prefilling:
             return False
         if self.live:
+            # a speculative round can commit up to (draft steps + 1)
+            # tokens per slot, so the per-block capacity window widens
+            # from sync_every iterations to sync_every rounds of that
+            # worst case (reservation-backed either way)
+            per_iter = (self._infer.spec_next_draft() + 1) \
+                if self._spec else 1
+            iters = self._infer.config.sync_every * per_iter
             for slot, req in self.live.items():
                 self._infer.ensure_decode_capacity(
-                    slot, int(self._last_pos[slot]),
-                    self._infer.config.sync_every)
+                    slot, int(self._last_pos[slot]), iters)
             self._infer.push_tables()
             self._decode_t0 = time.perf_counter()
-            self._infer.decode_block(self._infer.config.sync_every)
+            if self._spec:
+                self._infer.spec_block(self._infer.config.sync_every)
+            else:
+                self._infer.decode_block(self._infer.config.sync_every)
         else:
             self._decode_t0 = None
         self._fence(self._infer.config.sync_every if self.live else 0)
@@ -291,6 +310,16 @@ class ServingLoop:
             trk.on_fence_progress(self._decode_t0, iterations, deltas)
         for slot, req in finished:
             self._finish(slot, req, snap, now)
+        rollback_pages = 0
+        if self._spec:
+            # rejected-suffix rollback, host side: trim each live
+            # slot's page tables to its actual committed length (the
+            # device kv_limit was rewound inside verify; no page data
+            # moves) — the freed pages fund admissions this fence
+            for slot in self.live:
+                rollback_pages += self._infer.cache.rollback(
+                    slot, int(snap["pos"][slot]) + 1)
+            self._spec_fence(snap, window_s, iterations, rollback_pages)
         if new_tokens > 0:
             self.token_latencies.extend(
                 [window_s / new_tokens] * new_tokens)
@@ -315,6 +344,51 @@ class ServingLoop:
                                  len(self.prefilling))
         if mon.memory_enabled:
             mon._emit_memory_event(self._infer._host_steps)
+
+    def _spec_fence(self, snap, window_s, iterations, rollback_pages):
+        """Per-fence speculative accounting: diff the cumulative
+        device counters (read inside the ONE fetch_state device_get)
+        against the host mirrors, emit the `speculative` event, and
+        hand the tracker its drafted-vs-verified dispatch split."""
+        sp = snap["speculative"]
+        drafted = sp["drafted"].astype(np.int64)
+        accepted = sp["accepted"].astype(np.int64)
+        verified = sp["verified"].astype(np.int64)
+        rollbacks = sp["rollbacks"].astype(np.int64)
+        d = int((drafted - self._last_drafted).sum())
+        a = int((accepted - self._last_accepted).sum())
+        v = int((verified - self._last_verified).sum())
+        rb = int((rollbacks - self._last_rollbacks).sum())
+        rounds = int(sp["rounds"]) - self._last_rounds
+        self._last_drafted = drafted
+        self._last_accepted = accepted
+        self._last_verified = verified
+        self._last_rollbacks = rollbacks
+        self._last_rounds = int(sp["rounds"])
+        draft_s, verify_s = self._infer.spec_dispatch_split()
+        trk = self._infer.tracker
+        if trk is not None:
+            trk.on_speculative(draft_s, verify_s, d, a, v, rb)
+        if rounds <= 0 and d == 0:
+            return
+        self._infer.monitor.event(
+            "speculative",
+            rounds=int(rounds),
+            drafted_tokens=d,
+            accepted_tokens=a,
+            acceptance_rate=round(a / d, 4) if d > 0 else None,
+            # emitted tokens per flagship verify launch (each verified
+            # slot-round commits its accepted drafts + one flagship
+            # token) — THE speculative speedup number; vanilla decode
+            # is identically 1.0
+            tokens_per_verify=round((a + v) / v, 3) if v > 0 else None,
+            rollback_events=rb,
+            rollback_pages=int(rollback_pages),
+            mean_k=round(float(np.mean(
+                sp["k_slot"][snap["active"]])), 3)
+            if snap["active"].any() else None,
+            draft_dispatch_ms=round(draft_s * 1e3, 3),
+            verify_dispatch_ms=round(verify_s * 1e3, 3))
 
     def _finish(self, slot, req, snap, now):
         gen = int(snap["n_gen"][slot])

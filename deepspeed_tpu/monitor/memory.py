@@ -27,6 +27,11 @@ pure index math — NO device sync anywhere in this module):
                   pages) plus the unallocated remainder, so the
                   category total is always the true pool bytes
                   (inference/kv_cache.py)
+  kv_cache_draft  the speculative-decoding draft model's KV pool —
+                  same page tables and allocator as `kv_cache`, fewer
+                  layers; same unallocated + per-request split so the
+                  category total is the true draft pool bytes
+                  (inference/kv_cache.py attach_draft)
   ckpt_snapshot   checkpoint snapshot double-buffers — alive only
                   between the jitted snapshot and the writer's commit
   prefetch        staged batches queued ahead of the step loop
@@ -73,6 +78,7 @@ CAT_CKPT = "ckpt_snapshot"
 CAT_PREFETCH = "prefetch"
 CAT_PIPE = "pipe_buffers"
 CAT_KV = "kv_cache"
+CAT_KV_DRAFT = "kv_cache_draft"
 CAT_MOE = "moe_dispatch"
 CAT_OVERLAP = "overlap_inflight"
 
@@ -90,7 +96,7 @@ CAT_OVERLAP = "overlap_inflight"
 # rotations, ops/overlap.py) — likewise: per-step working memory that
 # scales with overlap.issue_distance)
 CATEGORIES = (CAT_PARAMS, CAT_MASTER, CAT_OPT, CAT_GRADS, CAT_ZERO3,
-              CAT_MOE, CAT_OVERLAP, CAT_KV, CAT_HOST_MASTER,
+              CAT_MOE, CAT_OVERLAP, CAT_KV, CAT_KV_DRAFT, CAT_HOST_MASTER,
               CAT_HOST_OPT, CAT_WIRE, CAT_CKPT, CAT_PREFETCH,
               CAT_PIPE)
 

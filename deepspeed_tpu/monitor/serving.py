@@ -152,6 +152,13 @@ class ServingTracker:
         self.goodput_tokens = 0
         self._queue_wait_s = 0.0     # over finished requests
         self._e2e_s = 0.0            # queued + wall over finished
+        # speculative decoding (ISSUE 18): cumulative draft/verify
+        # split. The times are host DISPATCH spans (the zero-sync loop
+        # cannot time device execution per program; everything settles
+        # at the fence), handed over by the scheduler each fence.
+        self.spec = {"draft_dispatch_s": 0.0, "verify_dispatch_s": 0.0,
+                     "drafted_tokens": 0, "accepted_tokens": 0,
+                     "verified_rounds": 0, "rollback_events": 0}
         self._armed = False
 
     # ------------------------------------------------------------------
@@ -374,6 +381,20 @@ class ServingTracker:
                 self._monitor.flight.arm()
         self._update_flight()
 
+    def on_speculative(self, draft_s, verify_s, drafted, accepted,
+                       verified, rollbacks):
+        """Per-fence speculative accounting from the scheduler: the
+        drafted-vs-verified dispatch-time split plus the round
+        counters (cumulative — they describe the run)."""
+        with self._lock:
+            sp = self.spec
+            sp["draft_dispatch_s"] += float(draft_s)
+            sp["verify_dispatch_s"] += float(verify_s)
+            sp["drafted_tokens"] += int(drafted)
+            sp["accepted_tokens"] += int(accepted)
+            sp["verified_rounds"] += int(verified)
+            sp["rollback_events"] += int(rollbacks)
+
     def on_reset(self):
         """engine.reset() dropped every slot (bench A/B hygiene): the
         live table empties; cumulative histograms/counters survive —
@@ -418,6 +439,17 @@ class ServingTracker:
             ttft_p99_ms=_r(self.hist_ttft_ms.percentile(0.99)),
             token_p50_ms=_r(self.hist_token_ms.percentile(0.50)),
             token_p99_ms=_r(self.hist_token_ms.percentile(0.99)))
+        with self._lock:
+            sp = dict(self.spec)
+        if sp["verified_rounds"] > 0:
+            d = sp["drafted_tokens"]
+            table["speculative"] = dict(
+                sp,
+                acceptance_rate=round(sp["accepted_tokens"] / d, 4)
+                if d > 0 else None,
+                tokens_per_verify=round(
+                    (sp["accepted_tokens"] + sp["verified_rounds"]) /
+                    sp["verified_rounds"], 3))
         return table
 
     def _update_flight(self):

@@ -696,3 +696,42 @@ INFERENCE_OBS_SLO_TTFT_MS = "slo_ttft_ms"
 INFERENCE_OBS_SLO_TTFT_MS_DEFAULT = 0.0
 INFERENCE_OBS_SLO_TOKEN_MS = "slo_token_ms"
 INFERENCE_OBS_SLO_TOKEN_MS_DEFAULT = 0.0
+#############################################
+# Speculative decoding (ISSUE 18, inference/speculative.py).
+#   {"inference": {"speculative": {"enabled": false,
+#                                  "draft_model": "truncate:1",
+#                                  "k": 4,
+#                                  "k_min": 1,
+#                                  "adaptive": true}}}
+# speculative.enabled: propose tokens with a cheap draft model and
+#   verify k+1 positions per flagship launch (lossless: greedy
+#   prefix-match at temperature 0, modified rejection sampling above —
+#   the output distribution is exactly the vanilla decode one). The
+#   default false leaves the engine's two compiled programs and its
+#   outputs byte-for-byte unchanged.
+# speculative.draft_model: where the draft comes from. "truncate:N"
+#   derives it from the flagship's first N transformer layers (shared
+#   embeddings / final LN / tied head — zero extra checkpoint);
+#   "external" uses the draft_params/draft_model_config pair passed to
+#   the InferenceEngine constructor.
+# speculative.k: drafted tokens per round — the verify program's
+#   static width is k+1 positions per slot.
+# speculative.k_min: adaptive back-off floor (1 = degenerate to one
+#   drafted token per round on hostile prompts).
+# speculative.adaptive: per-slot k adaptation — a slot that accepts a
+#   full round grows its k toward `k`, a slot whose acceptance EMA
+#   drops below the back-off threshold shrinks toward `k_min`; the
+#   host dispatches max(live k) draft steps per round, so a batch
+#   whose drafts are all being rejected stops paying for them.
+#############################################
+INFERENCE_SPECULATIVE = "speculative"
+INFERENCE_SPEC_ENABLED = "enabled"
+INFERENCE_SPEC_ENABLED_DEFAULT = False
+INFERENCE_SPEC_DRAFT_MODEL = "draft_model"
+INFERENCE_SPEC_DRAFT_MODEL_DEFAULT = "truncate:1"
+INFERENCE_SPEC_K = "k"
+INFERENCE_SPEC_K_DEFAULT = 4
+INFERENCE_SPEC_K_MIN = "k_min"
+INFERENCE_SPEC_K_MIN_DEFAULT = 1
+INFERENCE_SPEC_ADAPTIVE = "adaptive"
+INFERENCE_SPEC_ADAPTIVE_DEFAULT = True

@@ -42,6 +42,7 @@ def test_bench_list_prints_legs():
     assert "elastic_recovery" in legs
     assert "serving_throughput" in legs
     assert "serving_observability" in legs
+    assert "speculative_decode" in legs
     assert "moe_vs_dense" in legs
     assert "comm_overlap" in legs
     assert "moe_dispatch_kernel" in legs
@@ -74,7 +75,8 @@ def test_bench_list_and_only_error_agree_with_the_registry():
                 "numerics_overhead", "memory_ledger", "zero3_overlap",
                 "elastic_recovery", "serving_throughput",
                 "serving_observability", "moe_vs_dense",
-                "comm_overlap", "moe_dispatch_kernel"):
+                "comm_overlap", "moe_dispatch_kernel",
+                "speculative_decode"):
         assert leg in registry, leg
 
 
@@ -367,6 +369,42 @@ def test_bench_only_serving_observability_leg():
     # the <3% contract flag is recorded; catastrophic bound only here
     assert "regressed" in result
     assert result["overhead_pct"] < 25.0, result
+
+
+@pytest.mark.slow
+def test_bench_only_speculative_decode_leg():
+    """The speculative-decoding serving A/B (ISSUE 18) via `--only`:
+    draft-propose/flagship-verify vs vanilla decode on the same
+    Poisson arrival stream at temperature 0. Losslessness is
+    hard-asserted INSIDE the leg every trial (every request's token
+    stream bit-identical to vanilla — re-checked here via the recorded
+    flag); acceptance and tokens-per-verify are deterministic for the
+    damped-blocks model, so they get real bounds. The wall-clock
+    speedup is structural (~5 committed tokens per flagship verify at
+    1/8-cost draft steps; measures ~1.9x on this CPU mesh) but still a
+    timing ratio, so the smoke asserts a conservative floor under the
+    shared-box precedent — the >= 1.5x acceptance number is read off
+    the recorded bench line."""
+    proc = _bench_proc("--only", "speculative_decode", timeout=540,
+                       devices=8)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    d = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert d["leg"] == "speculative_decode"
+    result = d["result"]
+    assert "error" not in result, result
+    # temp-0 losslessness: hard-asserted in-leg, recorded here
+    assert result["temp0_bitexact"] is True, result
+    # deterministic draft-quality numbers for the damped model: high
+    # but NOT perfect acceptance, with the rollback path exercised
+    assert 0.9 <= result["acceptance_rate"] < 1.0, result
+    assert result["rollback_events"] > 0, result
+    assert result["tokens_per_verify"] > 3.0, result
+    assert result["drafted_tokens"] >= result["accepted_tokens"] > 0
+    assert result["vanilla_tokens_per_sec"] > 0
+    assert result["speculative_tokens_per_sec"] > 0
+    assert "target_1_5x_met" in result
+    # conservative shared-box floor; ~1.9x when the box is quiet
+    assert result["speculative_speedup"] >= 1.2, result
 
 
 def test_bench_only_quantized_matmul_leg():
